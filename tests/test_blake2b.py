@@ -1,0 +1,132 @@
+"""Golden-value tests: JAX blake2b vs hashlib.blake2b, bit-exact.
+
+The reference has no unit tests (SURVEY.md §4); correctness there rests on
+nanolib + the live network rejecting bad work. Here every limb-pair operation
+is verified against the CPython reference implementation.
+"""
+
+import hashlib
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dpow.ops import blake2b, u64
+
+
+def ref_work_value(nonce: int, block_hash: bytes) -> int:
+    d = hashlib.blake2b(
+        struct.pack("<Q", nonce) + block_hash, digest_size=8
+    ).digest()
+    return int.from_bytes(d, "little")
+
+
+def split64(x: int):
+    return np.uint32(x & 0xFFFFFFFF), np.uint32(x >> 32)
+
+
+def test_u64_add_carry():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1 << 64, size=256, dtype=np.uint64)
+    b = rng.integers(0, 1 << 64, size=256, dtype=np.uint64)
+    alo = (a & 0xFFFFFFFF).astype(np.uint32)
+    ahi = (a >> np.uint64(32)).astype(np.uint32)
+    blo = (b & 0xFFFFFFFF).astype(np.uint32)
+    bhi = (b >> np.uint64(32)).astype(np.uint32)
+    lo, hi = u64.add((jnp.asarray(alo), jnp.asarray(ahi)), (jnp.asarray(blo), jnp.asarray(bhi)))
+    got = np.asarray(hi).astype(np.uint64) << np.uint64(32) | np.asarray(lo).astype(np.uint64)
+    want = a + b  # uint64 wraps
+    np.testing.assert_array_equal(got, want)
+
+
+def test_u64_rotr_all_used_amounts():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 1 << 64, size=64, dtype=np.uint64)
+    lo = jnp.asarray((x & 0xFFFFFFFF).astype(np.uint32))
+    hi = jnp.asarray((x >> np.uint64(32)).astype(np.uint32))
+    for n in (16, 24, 32, 63, 1, 7, 33, 48):
+        rlo, rhi = u64.rotr((lo, hi), n)
+        got = np.asarray(rhi).astype(np.uint64) << np.uint64(32) | np.asarray(rlo).astype(np.uint64)
+        want = (x >> np.uint64(n)) | (x << np.uint64(64 - n))
+        np.testing.assert_array_equal(got, want, err_msg=f"rotr {n}")
+
+
+def test_u64_geq():
+    vals = [0, 1, 0xFFFFFFFF, 0x100000000, 0xFFFFFFFF00000000, (1 << 64) - 1]
+    for a in vals:
+        for b in vals:
+            got = bool(u64.geq(split64(a), split64(b)))
+            assert got == (a >= b), (a, b)
+
+
+def test_pow_work_value_scalar_golden():
+    rng = np.random.default_rng(2)
+    for _ in range(50):
+        block_hash = rng.bytes(32)
+        nonce = int(rng.integers(0, 1 << 63, dtype=np.uint64)) * 2 + int(
+            rng.integers(0, 2)
+        )
+        msg = blake2b.hash_to_message_words(block_hash)
+        lo, hi = blake2b.pow_work_value(split64(nonce), msg)
+        got = (int(np.asarray(hi)) << 32) | int(np.asarray(lo))
+        assert got == ref_work_value(nonce, block_hash)
+
+
+def test_pow_work_value_batched_jit_golden():
+    rng = np.random.default_rng(3)
+    block_hash = rng.bytes(32)
+    msg = blake2b.hash_to_message_words(block_hash)
+    nonces = rng.integers(0, 1 << 64, size=(4, 128), dtype=np.uint64)
+    nlo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
+    nhi = jnp.asarray((nonces >> np.uint64(32)).astype(np.uint32))
+
+    @jax.jit
+    def f(nlo, nhi):
+        return blake2b.pow_work_value((nlo, nhi), msg)
+
+    lo, hi = f(nlo, nhi)
+    got = np.asarray(hi).astype(np.uint64) << np.uint64(32) | np.asarray(lo).astype(np.uint64)
+    want = np.array(
+        [
+            [ref_work_value(int(n), block_hash) for n in row]
+            for row in nonces
+        ],
+        dtype=np.uint64,
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pow_meets_difficulty_matches_reference_rule():
+    rng = np.random.default_rng(4)
+    block_hash = rng.bytes(32)
+    msg = blake2b.hash_to_message_words(block_hash)
+    nonces = rng.integers(0, 1 << 64, size=64, dtype=np.uint64)
+    # Pick difficulty as the median of actual values so both outcomes occur.
+    vals = np.array([ref_work_value(int(n), block_hash) for n in nonces], dtype=np.uint64)
+    difficulty = int(np.sort(vals)[32])
+    nlo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
+    nhi = jnp.asarray((nonces >> np.uint64(32)).astype(np.uint32))
+    ok = blake2b.pow_meets_difficulty((nlo, nhi), msg, split64(difficulty))
+    np.testing.assert_array_equal(np.asarray(ok), vals >= np.uint64(difficulty))
+
+
+def test_generic_compress_matches_hashlib_empty_and_abc():
+    # Full-width digest via the generic compress: blake2b(b"abc"), 64-byte digest.
+    for data in (b"", b"abc", bytes(range(40)), b"x" * 128):
+        if len(data) > 128:
+            continue
+        h = [u64.from_int(blake2b.IV[0] ^ 0x01010000 ^ 64)] + [
+            u64.from_int(blake2b.IV[i]) for i in range(1, 8)
+        ]
+        block = data.ljust(128, b"\x00")
+        words = np.frombuffer(block, dtype="<u8")
+        m = [split64(int(w)) for w in words]
+        out = blake2b.compress(h, m, len(data), final=True)
+        got = b"".join(
+            int(np.asarray(lo)).to_bytes(4, "little")
+            + int(np.asarray(hi)).to_bytes(4, "little")
+            for lo, hi in out
+        )
+        assert got == hashlib.blake2b(data).digest(), data

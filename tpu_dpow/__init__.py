@@ -1,0 +1,25 @@
+"""tpu_dpow — a TPU-native Distributed Proof of Work framework.
+
+From-scratch rebuild of the capability surface of nano-dpow
+(reference: /root/reference): a server brokering Nano proof-of-work requests
+from services, a swarm of worker clients on a pub/sub transport, and — where
+the reference shells out to a Rust/OpenCL ``nano-work-server`` binary
+(reference client/bin, client/work_handler.py:104-108) — an in-process
+JAX/Pallas Blake2b nonce-search engine with the 64-bit nonce space vmapped
+across VPU lanes and sharded across TPU chips via ``shard_map``.
+
+Layout (SURVEY.md §7 build plan):
+  ops/        Blake2b on uint32 limb pairs; jnp + Pallas nonce search
+  models/     work-request / difficulty domain model
+  parallel/   device mesh, shard_map nonce sharding, winner election
+  utils/      nano crypto (accounts, difficulty), config, logging
+  store/      async state store (memory w/ TTL + snapshot, redis-gated)
+  transport/  pub/sub transport: in-process + TCP broker w/ auth+ACL
+  backend/    WorkBackend protocol: jax (TPU), native (C++), subprocess
+  server/     request orchestrator + service HTTP/WS API
+  client/     worker client + work handler
+  workserver/ standalone HTTP JSON-RPC work server (nano-work-server compatible)
+  scripts/    operator CLIs (services, snapshot, payouts, latency)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from . import u64, blake2b  # noqa: F401

@@ -1,0 +1,163 @@
+"""Blake2b compression specialized for the Nano proof-of-work rule, in JAX.
+
+Nano's PoW (reference server/dpow_server.py:130 via nanolib; native search in
+the vendored nano-work-server, reference client/bin): find an 8-byte nonce
+``w`` such that
+
+    work_value = LE_u64( blake2b(digest_size=8, w_le || block_hash) )
+    work_value >= difficulty
+
+The message is always exactly 40 bytes (one compression block), keyless, with
+an 8-byte digest — so the full Blake2b streaming machinery collapses to a
+single compression call with t0 = 40 and the final-block flag set, and the
+work value is simply the final h[0] word. Everything here runs on uint32 limb
+pairs (see ops/u64.py) because the TPU VPU has no 64-bit lanes.
+
+Verified bit-exactly against ``hashlib.blake2b`` in tests/test_blake2b.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import u64
+from .u64 import U64
+
+# Blake2b IV (RFC 7693 §2.6).
+IV = (
+    0x6A09E667F3BCC908,
+    0xBB67AE8584CAA73B,
+    0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1,
+    0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B,
+    0x5BE0CD19137E2179,
+)
+
+# Message schedule (RFC 7693 §2.7); Blake2b runs 12 rounds, rounds 10 and 11
+# repeat permutations 0 and 1.
+SIGMA = (
+    (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    (14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3),
+    (11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4),
+    (7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8),
+    (9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13),
+    (2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9),
+    (12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11),
+    (13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10),
+    (6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5),
+    (10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0),
+    (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    (14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3),
+)
+
+# h[0] for a keyless, 8-byte-digest instance: IV[0] ^ 0x0101_0000 ^ digest_len.
+POW_DIGEST_SIZE = 8
+POW_MESSAGE_LEN = 40  # 8-byte nonce || 32-byte block hash
+H0_POW = IV[0] ^ 0x01010000 ^ POW_DIGEST_SIZE
+
+
+def _g(v: List[U64], a: int, b: int, c: int, d: int, x: U64, y: U64) -> None:
+    """Blake2b G mixing function on the working vector, in place."""
+    v[a] = u64.add3(v[a], v[b], x)
+    v[d] = u64.rotr(u64.xor(v[d], v[a]), 32)
+    v[c] = u64.add(v[c], v[d])
+    v[b] = u64.rotr(u64.xor(v[b], v[c]), 24)
+    v[a] = u64.add3(v[a], v[b], y)
+    v[d] = u64.rotr(u64.xor(v[d], v[a]), 16)
+    v[c] = u64.add(v[c], v[d])
+    v[b] = u64.rotr(u64.xor(v[b], v[c]), 63)
+
+
+def compress(
+    h: Sequence[U64],
+    m: Sequence[U64],
+    t0: int,
+    final: bool,
+) -> List[U64]:
+    """One Blake2b compression: h (8 words), m (16 words), byte counter t0.
+
+    All words are (lo, hi) uint32 pairs; any consistent broadcastable batch
+    shape works. Returns the updated h.
+    """
+    v: List[U64] = list(h) + [u64.from_int(IV[i]) for i in range(8)]
+    # Broadcast the IV halves against the batch shape of h via xor identities
+    # below; t1 is always 0 for single-block messages.
+    v[12] = u64.xor(v[12], u64.from_int(t0))
+    if final:
+        v[14] = u64.xor(v[14], u64.from_int(0xFFFFFFFFFFFFFFFF))
+    for r in range(12):
+        s = SIGMA[r]
+        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+    return [u64.xor(u64.xor(h[i], v[i]), v[i + 8]) for i in range(8)]
+
+
+def hash_to_message_words(block_hash: bytes) -> np.ndarray:
+    """32-byte block hash → the 4 fixed message words m[1..4], as uint32[8].
+
+    Layout: [m1_lo, m1_hi, m2_lo, m2_hi, m3_lo, m3_hi, m4_lo, m4_hi]. Host-side
+    prep; the result is fed to the device once per work request.
+    """
+    if len(block_hash) != 32:
+        raise ValueError(f"block hash must be 32 bytes, got {len(block_hash)}")
+    words = np.frombuffer(block_hash, dtype="<u8")
+    out = np.empty(8, dtype=np.uint32)
+    out[0::2] = (words & 0xFFFFFFFF).astype(np.uint32)
+    out[1::2] = (words >> 32).astype(np.uint32)
+    return out
+
+
+def pow_work_value(nonce: U64, msg_words: Sequence[jnp.ndarray]) -> U64:
+    """Work value for nonce(s) against a block hash, as a u64 (lo, hi) pair.
+
+    ``nonce`` is the candidate work as (lo, hi) uint32 arrays of any batch
+    shape; ``msg_words`` is the 8-element uint32 sequence from
+    :func:`hash_to_message_words` (scalars or broadcastable arrays).
+
+    This IS the PoW hot loop body: a single specialized compression with
+    m[0] = nonce, m[1..4] = block hash, m[5..15] = 0, t0 = 40, final = True,
+    digest = first 8 bytes = final h[0].
+    """
+    zero: U64 = (np.uint32(0), np.uint32(0))
+    m: List[U64] = [nonce]
+    for i in range(4):
+        m.append((msg_words[2 * i], msg_words[2 * i + 1]))
+    m.extend([zero] * 11)
+
+    h: List[U64] = [u64.from_int(H0_POW)] + [u64.from_int(IV[i]) for i in range(1, 8)]
+
+    # Inline single-block compression; only h[0] is needed, but computing the
+    # full working vector is unavoidable (every v word feeds the rounds).
+    v: List[U64] = list(h) + [u64.from_int(IV[i]) for i in range(8)]
+    v[12] = u64.xor(v[12], u64.from_int(POW_MESSAGE_LEN))
+    v[14] = u64.xor(v[14], u64.from_int(0xFFFFFFFFFFFFFFFF))
+    for r in range(12):
+        s = SIGMA[r]
+        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+    h0 = u64.from_int(H0_POW)
+    return u64.xor(u64.xor(h0, v[0]), v[8])
+
+
+def pow_meets_difficulty(
+    nonce: U64, msg_words: Sequence[jnp.ndarray], difficulty: U64
+) -> jnp.ndarray:
+    """Elementwise: does blake2b_8(nonce || hash) meet the difficulty?"""
+    return u64.geq(pow_work_value(nonce, msg_words), difficulty)

@@ -1,0 +1,86 @@
+"""64-bit arithmetic emulated on uint32 limb pairs.
+
+TPU vector lanes are 32-bit: there is no native u64 on the VPU, so every
+64-bit quantity is carried as a ``(lo, hi)`` pair of ``uint32`` arrays and
+every add/xor/rotate is expressed in carry-correct uint32 ops. This module is
+the ground layer under the Blake2b compression function (ops/blake2b.py) and
+works identically under ``jax.jit``/``vmap`` and inside Pallas kernel bodies.
+
+The same functions accept numpy arrays, so host-side golden tests can run the
+identical code path without JAX tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# A 64-bit value as (lo, hi) uint32 limbs. Both limbs always share a shape.
+U64 = Tuple[jnp.ndarray, jnp.ndarray]
+
+MASK32 = np.uint32(0xFFFFFFFF)
+
+
+def from_int(value: int, like=None) -> U64:
+    """Split a Python int (mod 2**64) into uint32 (lo, hi) scalars/arrays."""
+    value &= (1 << 64) - 1
+    lo = np.uint32(value & 0xFFFFFFFF)
+    hi = np.uint32(value >> 32)
+    if like is not None:
+        lo = jnp.full_like(like, lo)
+        hi = jnp.full_like(like, hi)
+    return lo, hi
+
+
+def to_int(x: U64) -> int:
+    """Collapse a scalar (lo, hi) pair back to a Python int (host only)."""
+    lo, hi = x
+    return (int(np.asarray(hi)) << 32) | int(np.asarray(lo))
+
+
+def add(a: U64, b: U64) -> U64:
+    """Carry-correct 64-bit add: lo wraps mod 2**32, carry feeds hi."""
+    alo, ahi = a
+    blo, bhi = b
+    lo = alo + blo
+    # uint32 wrap-around: a sum smaller than either operand means a carry.
+    carry = (lo < alo).astype(jnp.uint32)
+    hi = ahi + bhi + carry
+    return lo, hi
+
+
+def add3(a: U64, b: U64, c: U64) -> U64:
+    return add(add(a, b), c)
+
+
+def xor(a: U64, b: U64) -> U64:
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def rotr(x: U64, n: int) -> U64:
+    """Rotate right by n bits (0 < n < 64). n is static (trace-time)."""
+    lo, hi = x
+    if n == 32:
+        return hi, lo
+    if n < 32:
+        sl = np.uint32(32 - n)
+        sr = np.uint32(n)
+        new_lo = (lo >> sr) | (hi << sl)
+        new_hi = (hi >> sr) | (lo << sl)
+        return new_lo, new_hi
+    # n > 32: rotr(n) == rotr(n - 32) after a limb swap.
+    m = n - 32
+    sl = np.uint32(32 - m)
+    sr = np.uint32(m)
+    new_lo = (hi >> sr) | (lo << sl)
+    new_hi = (lo >> sr) | (hi << sl)
+    return new_lo, new_hi
+
+
+def geq(a: U64, b: U64) -> jnp.ndarray:
+    """Unsigned 64-bit a >= b, elementwise."""
+    alo, ahi = a
+    blo, bhi = b
+    return (ahi > bhi) | ((ahi == bhi) & (alo >= blo))
