@@ -1,0 +1,71 @@
+import pytest
+
+from tpu_dpow.models import DifficultyModel, WorkRequest, WorkResult, WorkType
+from tpu_dpow.utils import nanocrypto as nc
+
+
+def test_work_request_canonicalizes():
+    r = WorkRequest("ab" * 32, nc.BASE_DIFFICULTY)
+    assert r.block_hash == "AB" * 32
+    assert r.difficulty_hex == "ffffffc000000000"
+    assert r.multiplier == pytest.approx(1.0)
+    with pytest.raises(nc.InvalidBlockHash):
+        WorkRequest("zz", 1)
+
+
+def test_difficulty_model_resolution():
+    m = DifficultyModel()
+    assert m.resolve() == nc.BASE_DIFFICULTY
+    assert m.resolve(multiplier=2.0) == nc.derive_work_difficulty(2.0)
+    # 8x the base (the benchmark's hard difficulty) needs a raised cap
+    m8 = DifficultyModel(max_multiplier=8.0)
+    assert m8.resolve(difficulty_hex="fffffff800000000") == 0xFFFFFFF800000000
+    with pytest.raises(nc.InvalidMultiplier):
+        m.resolve(difficulty_hex="fffffff800000000")
+    # difficulty field wins over multiplier (reference behavior)
+    assert m.resolve(difficulty_hex="ffffffc000000000", multiplier=4.0) == nc.BASE_DIFFICULTY
+    with pytest.raises(nc.InvalidMultiplier):
+        m.resolve(multiplier=50.0)
+    with pytest.raises(nc.InvalidMultiplier):
+        m.resolve(multiplier=0.01)
+    with pytest.raises(nc.InvalidMultiplier):
+        m.resolve(difficulty_hex="ffffffffffffffff")  # way above 5x
+
+
+def test_precache_reuse_threshold():
+    m = DifficultyModel()
+    base = nc.BASE_DIFFICULTY
+    d2 = nc.derive_work_difficulty(2.0)
+    # precached at base, requested at 2x: 1.0 < 0.8*2.0 → not usable
+    assert not m.precache_usable(base, d2)
+    # precached at 2x, requested at 2x → usable
+    assert m.precache_usable(d2, d2)
+    # precached at 1.7x, requested at 2x: 1.7 >= 1.6 → usable
+    assert m.precache_usable(nc.derive_work_difficulty(1.7), d2)
+
+
+def test_work_type_topics():
+    assert WorkType.ANY.topics == ["precache", "ondemand"]
+    assert WorkType.ONDEMAND.topics == ["ondemand"]
+
+
+def test_work_result_validate():
+    import hashlib, struct
+
+    h = "00" * 32
+    # brute-force an easy nonce on host for the test
+    target = 1 << 48
+    w = 0
+    while True:
+        v = int.from_bytes(
+            hashlib.blake2b(struct.pack("<Q", w) + bytes(32), digest_size=8).digest(),
+            "little",
+        )
+        if v >= target:
+            break
+        w += 1
+    res = WorkResult(h, f"{w:016x}")
+    assert res.value() == v
+    res.validate(target)
+    with pytest.raises(nc.InvalidWork):
+        WorkResult(h, "0" * 16).validate(0xFFFFFFFFFFFFFFFF)

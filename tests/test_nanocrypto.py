@@ -1,0 +1,85 @@
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+from tpu_dpow.utils import nanocrypto as nc
+
+# Well-known Nano genesis account (public protocol constant).
+GENESIS_PUB = "E89208DD038FBB269987689621D52292AE9C35941A7484756ECCED92A65093BA"
+GENESIS_ACCOUNT = "nano_3t6k35gi95xu6tergt6p69ck76ogmitsa8mnijtpxm9fkcm736xtoncuohr3"
+
+
+def test_account_roundtrip_genesis():
+    assert nc.encode_account(bytes.fromhex(GENESIS_PUB)) == GENESIS_ACCOUNT
+    assert nc.decode_account(GENESIS_ACCOUNT).hex().upper() == GENESIS_PUB
+    assert nc.is_valid_account(GENESIS_ACCOUNT)
+    assert nc.is_valid_account("xrb_" + GENESIS_ACCOUNT[5:])
+
+
+def test_account_rejects_corruption():
+    bad = GENESIS_ACCOUNT[:-1] + ("1" if GENESIS_ACCOUNT[-1] != "1" else "3")
+    assert not nc.is_valid_account(bad)
+    assert not nc.is_valid_account("nano_short")
+    assert not nc.is_valid_account("btc_" + GENESIS_ACCOUNT[5:])
+    with pytest.raises(nc.InvalidAccount):
+        nc.validate_account(bad)
+
+
+def test_account_roundtrip_random():
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        pub = rng.bytes(32)
+        acct = nc.encode_account(pub)
+        assert nc.decode_account(acct) == pub
+
+
+def test_work_value_and_validate():
+    rng = np.random.default_rng(8)
+    h = rng.bytes(32).hex()
+    w = 0x123456789ABCDEF0
+    whex = f"{w:016x}"
+    expect = int.from_bytes(
+        hashlib.blake2b(struct.pack("<Q", w) + bytes.fromhex(h), digest_size=8).digest(),
+        "little",
+    )
+    assert nc.work_value(h, whex) == expect
+    # Validation passes at a difficulty equal to the value, fails just above.
+    assert nc.validate_work(h, whex, expect) == whex
+    if expect < nc.MAX_U64:
+        with pytest.raises(nc.InvalidWork):
+            nc.validate_work(h, whex, expect + 1)
+
+
+def test_difficulty_multiplier_roundtrip():
+    for mult in (0.125, 0.5, 1.0, 2.0, 5.0, 8.0):
+        d = nc.derive_work_difficulty(mult)
+        back = nc.derive_work_multiplier(d)
+        assert back == pytest.approx(mult, rel=1e-9)
+    assert nc.derive_work_difficulty(1.0) == nc.BASE_DIFFICULTY
+    # Known relationship: 8x the base 0xffffffc... ≈ 0xfffffff8...
+    assert nc.derive_work_difficulty(8.0) == 0xFFFFFFF800000000
+
+
+def test_validators():
+    assert nc.validate_block_hash("ab" * 32) == "AB" * 32
+    with pytest.raises(nc.InvalidBlockHash):
+        nc.validate_block_hash("xyz")
+    assert nc.validate_work_hex("ABCDEF0123456789") == "abcdef0123456789"
+    with pytest.raises(nc.InvalidWork):
+        nc.validate_work_hex("123")
+    assert nc.validate_difficulty("ffffffc000000000") == "ffffffc000000000"
+    assert nc.validate_difficulty("1f") == "000000000000001f"
+    with pytest.raises(nc.InvalidDifficulty):
+        nc.validate_difficulty("gg")
+
+
+def test_denominations():
+    assert nc.nano_to_raw("1") == 10**30
+    assert nc.raw_to_nano(5 * 10**29) == nc.Decimal("0.5")
+
+
+def test_expected_hashes():
+    assert nc.expected_hashes(nc.BASE_DIFFICULTY) == pytest.approx(2**26, rel=1e-6)
+    assert nc.expected_hashes(0xFFFFFFF800000000) == pytest.approx(2**29, rel=1e-6)

@@ -1,0 +1,1 @@
+from .work import WorkType, WorkRequest, WorkResult, DifficultyModel  # noqa: F401

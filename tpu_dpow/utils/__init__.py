@@ -1,0 +1,1 @@
+from . import nanocrypto  # noqa: F401
