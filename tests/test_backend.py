@@ -1,0 +1,132 @@
+"""JaxWorkBackend: generate/cancel/dedup/batch semantics on the CPU path."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tpu_dpow.backend import WorkCancelled, get_backend
+from tpu_dpow.backend.jax_backend import JaxWorkBackend
+from tpu_dpow.models import WorkRequest, WorkType
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(5)
+EASY = 0xFFF0000000000000  # ~1 in 4096 nonces: a few ms on the CPU path
+
+
+def make_backend(**kw):
+    return JaxWorkBackend(kernel="xla", sublanes=8, iters=8, **kw)
+
+
+def random_hash() -> str:
+    return RNG.bytes(32).hex().upper()
+
+
+@pytest.fixture()
+def backend():
+    b = make_backend()
+    yield b
+
+
+async def _setup(b):
+    await b.setup()
+    return b
+
+
+def test_generate_produces_valid_work(backend):
+    async def run():
+        await backend.setup()
+        h = random_hash()
+        work = await backend.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        await backend.close()
+
+    asyncio.run(run())
+
+
+def test_generate_concurrent_batch(backend):
+    async def run():
+        await backend.setup()
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(5)]
+        works = await asyncio.gather(*(backend.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        assert backend.total_solutions == 5
+        await backend.close()
+
+    asyncio.run(run())
+
+
+def test_generate_dedups_same_hash(backend):
+    async def run():
+        await backend.setup()
+        h = random_hash()
+        r = WorkRequest(h, EASY)
+        w1, w2 = await asyncio.gather(backend.generate(r), backend.generate(r))
+        assert w1 == w2
+        assert backend.total_solutions == 1
+        await backend.close()
+
+    asyncio.run(run())
+
+
+def test_cancel_in_flight(backend):
+    async def run():
+        await backend.setup()
+        h = random_hash()
+        # Hard difficulty: would take ~forever on CPU, must be cancellable.
+        hard = nc.derive_work_difficulty(4.0)
+        task = asyncio.ensure_future(backend.generate(WorkRequest(h, hard)))
+        await asyncio.sleep(0.2)
+        assert not task.done()
+        await backend.cancel(h)
+        with pytest.raises(WorkCancelled):
+            await task
+        await backend.close()
+
+    asyncio.run(run())
+
+
+def test_cancel_unknown_hash_is_noop(backend):
+    async def run():
+        await backend.setup()
+        await backend.cancel("AB" * 32)
+        await backend.close()
+
+    asyncio.run(run())
+
+
+def test_close_cancels_everything(backend):
+    async def run():
+        await backend.setup()
+        hard = nc.derive_work_difficulty(4.0)
+        task = asyncio.ensure_future(backend.generate(WorkRequest(random_hash(), hard)))
+        await asyncio.sleep(0.1)
+        await backend.close()
+        with pytest.raises(WorkCancelled):
+            await task
+
+    asyncio.run(run())
+
+
+def test_engine_restarts_after_idle():
+    async def run():
+        b = make_backend()
+        await b.setup()
+        h1 = random_hash()
+        w = await b.generate(WorkRequest(h1, EASY))
+        nc.validate_work(h1, w, EASY)
+        # engine goes idle; a later request must restart it
+        await asyncio.sleep(0.05)
+        h2 = random_hash()
+        w2 = await b.generate(WorkRequest(h2, EASY))
+        nc.validate_work(h2, w2, EASY)
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_registry():
+    assert isinstance(get_backend("jax", kernel="xla"), JaxWorkBackend)
+    with pytest.raises(ValueError):
+        get_backend("quantum")

@@ -18,6 +18,14 @@ def test_account_roundtrip_genesis():
     assert nc.is_valid_account("xrb_" + GENESIS_ACCOUNT[5:])
 
 
+def test_account_rejects_noncanonical_padding_alias():
+    # Setting a pad bit yields an alias spelling of the same public key;
+    # canonical decoding must reject it (first body char '3' -> 'j' flips
+    # pad bit 258 for the genesis address).
+    alias = "nano_j" + GENESIS_ACCOUNT[6:]
+    assert not nc.is_valid_account(alias)
+
+
 def test_account_rejects_corruption():
     bad = GENESIS_ACCOUNT[:-1] + ("1" if GENESIS_ACCOUNT[-1] != "1" else "3")
     assert not nc.is_valid_account(bad)

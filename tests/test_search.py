@@ -1,0 +1,104 @@
+"""Nonce-search correctness: jnp path, Pallas kernel (interpret), batching."""
+
+import hashlib
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dpow.ops import pallas_kernel, search
+
+RNG = np.random.default_rng(42)
+
+
+def ref_value(nonce: int, h: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(struct.pack("<Q", nonce & ((1 << 64) - 1)) + h, digest_size=8).digest(),
+        "little",
+    )
+
+
+def first_valid_offset(h: bytes, difficulty: int, base: int, window: int):
+    for off in range(window):
+        if ref_value(base + off, h) >= difficulty:
+            return off
+    return None
+
+
+EASY = 0xFFF0000000000000  # ~1 in 4096 nonces
+
+
+def test_search_chunk_finds_first_valid():
+    h = RNG.bytes(32)
+    params = search.pack_params(h, EASY, base=999)
+    off = int(search.search_chunk(params, chunk_size=16384))
+    assert off != int(search.SENTINEL)
+    assert off == first_valid_offset(h, EASY, 999, off + 1)
+
+
+def test_search_chunk_none_found():
+    h = RNG.bytes(32)
+    params = search.pack_params(h, (1 << 64) - 1, base=0)
+    off = int(search.search_chunk(params, chunk_size=2048))
+    # all-ones difficulty is unreachable except with probability 2^-64/hash
+    assert off == int(search.SENTINEL)
+
+
+def test_search_chunk_base_carry_across_32bit_boundary():
+    h = RNG.bytes(32)
+    base = (5 << 32) - 100  # offsets cross the lo-limb wrap
+    params = search.pack_params(h, EASY, base=base)
+    off = int(search.search_chunk(params, chunk_size=8192))
+    assert off != int(search.SENTINEL)
+    assert ref_value(base + off, h) >= EASY
+    assert first_valid_offset(h, EASY, base, off + 1) == off
+
+
+def test_search_chunk_batch_matches_single():
+    hashes = [RNG.bytes(32) for _ in range(4)]
+    params = np.stack(
+        [search.pack_params(h, EASY, base=i * 1000) for i, h in enumerate(hashes)]
+    )
+    batch = np.asarray(search.search_chunk_batch(jnp.asarray(params), chunk_size=8192))
+    for i, h in enumerate(hashes):
+        single = int(search.search_chunk(jnp.asarray(params[i]), chunk_size=8192))
+        assert batch[i] == single
+
+
+def test_pallas_interpret_matches_jnp():
+    h = RNG.bytes(32)
+    params = jnp.asarray(search.pack_params(h, EASY, base=31337))
+    n = pallas_kernel.chunk_size(8, 16)
+    want = int(search.search_chunk(params, chunk_size=n))
+    got = int(
+        pallas_kernel.pallas_search_chunk(params, sublanes=8, iters=16, interpret=True)
+    )
+    assert got == want
+
+
+def test_pallas_interpret_batch():
+    hashes = [RNG.bytes(32) for _ in range(3)]
+    params = np.stack([search.pack_params(h, EASY, base=77) for h in hashes])
+    n = pallas_kernel.chunk_size(8, 8)
+    got = np.asarray(
+        pallas_kernel.pallas_search_chunk_batch(
+            jnp.asarray(params), sublanes=8, iters=8, interpret=True
+        )
+    )
+    for i in range(3):
+        want = int(search.search_chunk(jnp.asarray(params[i]), chunk_size=n))
+        assert got[i] == want
+
+
+def test_pallas_launch_window_cap():
+    h = RNG.bytes(32)
+    params = jnp.asarray(search.pack_params(h, EASY, base=0))
+    with pytest.raises(ValueError):
+        pallas_kernel.pallas_search_chunk(params, sublanes=1024, iters=1 << 16, interpret=True)
+
+
+def test_work_hex_convention():
+    # nano work hex is the big-endian rendering of the u64 nonce
+    assert search.work_hex_from_nonce(0x123456789ABCDEF0) == "123456789abcdef0"
+    assert search.nonce_from_offset((1 << 64) - 1, 2) == 1

@@ -1,0 +1,75 @@
+"""WorkBackend: the dispatch boundary where compute engines plug in.
+
+The reference's equivalent seam is ``client/work_handler.py:104-108`` — an
+HTTP POST of ``{"action": "work_generate", hash, difficulty}`` to the
+vendored Rust/OpenCL ``nano-work-server`` on 127.0.0.1:7000, with
+``work_cancel`` aborting an in-flight hash. The rebuild makes the seam an
+async protocol with three interchangeable engines:
+
+  * :class:`~tpu_dpow.backend.jax_backend.JaxWorkBackend` — in-process
+    JAX/Pallas nonce search on TPU (or any JAX backend), with request
+    batching and cancel-by-masking. The flagship path.
+  * :class:`~tpu_dpow.backend.native_backend.NativeWorkBackend` — C++
+    multithreaded CPU search via ctypes (the reference's CPU mode analog).
+  * :class:`~tpu_dpow.backend.subprocess_backend.SubprocessWorkBackend` —
+    HTTP JSON-RPC to an external nano-work-server-compatible process,
+    preserving drop-in compatibility with the reference's deployment.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+from ..models import WorkRequest
+
+
+class WorkError(Exception):
+    """The backend failed to produce work."""
+
+
+class WorkCancelled(WorkError):
+    """The in-flight request was cancelled (reference work_cancel analog)."""
+
+
+class WorkBackend(abc.ABC):
+    """Async engine producing Nano proof-of-work."""
+
+    @abc.abstractmethod
+    async def setup(self) -> None:
+        """Probe/initialize the engine; raise if unavailable.
+
+        Mirrors the reference's startup probe that POSTs an invalid action
+        and expects an error reply (reference client/work_handler.py:50-55).
+        """
+
+    @abc.abstractmethod
+    async def generate(self, request: WorkRequest) -> str:
+        """Search until a valid nonce is found → 16-hex-char work string.
+
+        Raises WorkCancelled if cancel() arrives first.
+        """
+
+    @abc.abstractmethod
+    async def cancel(self, block_hash: str) -> None:
+        """Abort an in-flight generate for this hash (idempotent)."""
+
+    async def close(self) -> None:  # pragma: no cover - trivial default
+        return None
+
+
+def get_backend(name: str, **kwargs) -> WorkBackend:
+    """Construct a backend by name: 'jax' | 'native' | 'subprocess'."""
+    if name == "jax":
+        from .jax_backend import JaxWorkBackend
+
+        return JaxWorkBackend(**kwargs)
+    if name == "native":
+        from .native_backend import NativeWorkBackend
+
+        return NativeWorkBackend(**kwargs)
+    if name == "subprocess":
+        from .subprocess_backend import SubprocessWorkBackend
+
+        return SubprocessWorkBackend(**kwargs)
+    raise ValueError(f"unknown work backend: {name!r}")

@@ -1,0 +1,226 @@
+"""In-process JAX/TPU work engine: batched, cancellable nonce search.
+
+Replaces the reference's external ``nano-work-server`` process (reference
+client/bin; HTTP contract at client/work_handler.py:104-108) with an
+in-process engine built on the chunk scanners in ops/:
+
+  * Every active request gets a decorrelating random 64-bit start base —
+    the same swarm decorrelation the reference gets from each worker's
+    random starting nonce (SURVEY.md §2.5) — then advances deterministically
+    chunk by chunk.
+  * All active requests are packed into ONE fixed-shape batched launch per
+    engine step (padded with unreachable-difficulty dummies, so arrival and
+    completion never change the compiled shape — no recompiles, SURVEY.md
+    §7 hard part #4). Concurrent hashes share a single device dispatch,
+    replacing the reference's one-POST-per-item worker dialogue.
+  * Cancels are lane masking: a cancelled job is dropped from the next
+    pack; the chunk already in flight finishes and its result is discarded
+    — the same cancel/completion race resolution the reference implements
+    with its ``work_ongoing`` set (reference client/work_handler.py:109-114).
+  * Chunked launches bound cancel latency and let the host check for
+    cancels between steps (a SIMD machine cannot break mid-launch; SURVEY.md
+    §7 hard part #2).
+
+Every found nonce is re-validated on host against hashlib before being
+returned (the belt to the device's suspenders, mirroring the reference's
+final nanolib.validate_work at server/dpow_server.py:363-368).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import WorkRequest
+from ..ops import pallas_kernel, search
+from ..utils import nanocrypto as nc
+from . import WorkBackend, WorkCancelled, WorkError
+
+_UNREACHABLE = (1 << 64) - 1  # padding difficulty: P(hit) = 2^-64 per hash
+
+
+@dataclass
+class _Job:
+    request: WorkRequest
+    base: int
+    future: asyncio.Future
+    cancelled: bool = False
+    hashes_done: int = 0
+
+
+class JaxWorkBackend(WorkBackend):
+    """Batched chunked nonce search on whatever jax.devices() provides."""
+
+    def __init__(
+        self,
+        *,
+        kernel: Optional[str] = None,  # 'pallas' | 'xla' | None = auto
+        sublanes: int = 64,
+        iters: int = 512,
+        max_batch: int = 16,
+        interpret: bool = False,
+        device: Optional[jax.Device] = None,
+    ):
+        self.device = device or jax.devices()[0]
+        on_tpu = self.device.platform == "tpu"
+        self.kernel = kernel or ("pallas" if on_tpu else "xla")
+        self.sublanes = sublanes
+        self.iters = iters
+        if self.kernel == "xla" and not on_tpu:
+            # CPU fallback/test path: small chunks keep latency sane.
+            self.sublanes = min(sublanes, 8)
+            self.iters = min(iters, 8)
+        self.chunk = self.sublanes * 128 * self.iters
+        self.max_batch = max_batch
+        self.interpret = interpret
+        self._jobs: Dict[str, _Job] = {}
+        self._engine_task: Optional[asyncio.Task] = None
+        self._wakeup = asyncio.Event()
+        self._closed = False
+        self.total_hashes = 0
+        self.total_solutions = 0
+
+    # -- WorkBackend interface -------------------------------------------
+
+    async def setup(self) -> None:
+        # Self-test: the engine must find a planted easy solution. Also pays
+        # the one-time jit compile cost off the event loop.
+        probe = search.pack_params(bytes(32), 1, base=0)
+        out = await asyncio.to_thread(self._launch, np.stack([probe]))
+        if int(out[0]) != 0:
+            raise WorkError(f"backend self-test failed (offset {int(out[0])})")
+
+    async def generate(self, request: WorkRequest) -> str:
+        if self._closed:
+            raise WorkError("backend closed")
+        key = request.block_hash
+        existing = self._jobs.get(key)
+        if existing is not None and not existing.cancelled:
+            # Dedup concurrent generates for the same hash (the reference
+            # dedups on enqueue, client/work_handler.py:84-89).
+            return await asyncio.shield(existing.future)
+        job = _Job(
+            request=request,
+            base=secrets.randbits(64),
+            future=asyncio.get_running_loop().create_future(),
+        )
+        self._jobs[key] = job
+        self._ensure_engine()
+        self._wakeup.set()
+        try:
+            return await asyncio.shield(job.future)
+        except asyncio.CancelledError:
+            job.cancelled = True
+            raise
+
+    async def cancel(self, block_hash: str) -> None:
+        job = self._jobs.get(nc.validate_block_hash(block_hash))
+        if job is not None and not job.future.done():
+            job.cancelled = True
+            job.future.set_exception(WorkCancelled(job.request.block_hash))
+
+    async def close(self) -> None:
+        self._closed = True
+        for job in list(self._jobs.values()):
+            if not job.future.done():
+                job.future.set_exception(WorkCancelled("backend closed"))
+        self._jobs.clear()
+        self._wakeup.set()
+        if self._engine_task is not None:
+            await self._engine_task
+            self._engine_task = None
+
+    # -- engine -----------------------------------------------------------
+
+    def _ensure_engine(self) -> None:
+        if self._engine_task is None or self._engine_task.done():
+            self._engine_task = asyncio.ensure_future(self._engine_loop())
+
+    def _launch(self, params_batch: np.ndarray) -> np.ndarray:
+        """One blocking batched device step (called via to_thread)."""
+        pj = jnp.asarray(params_batch)
+        if self.kernel == "pallas":
+            out = pallas_kernel.pallas_search_chunk_batch(
+                pj,
+                sublanes=self.sublanes,
+                iters=self.iters,
+                interpret=self.interpret,
+            )
+        else:
+            out = search.search_chunk_batch(pj, chunk_size=self.chunk)
+        return np.asarray(out)
+
+    def _pack(self, jobs: list) -> np.ndarray:
+        """Fixed-shape batch: active jobs + unreachable-difficulty padding."""
+        b = 1
+        while b < len(jobs):
+            b *= 2
+        b = min(max(b, 1), self.max_batch)
+        out = np.empty((b, search.PARAMS_LEN), dtype=np.uint32)
+        for i in range(b):
+            if i < len(jobs):
+                job = jobs[i]
+                out[i] = search.pack_params(
+                    job.request.hash_bytes, job.request.difficulty, job.base
+                )
+            else:
+                out[i] = search.pack_params(bytes(32), _UNREACHABLE, 0)
+        return out
+
+    async def _engine_loop(self) -> None:
+        try:
+            await self._engine_loop_inner()
+        except Exception as e:
+            # A dead engine must never strand waiters on unresolved futures.
+            for job in self._jobs.values():
+                if not job.future.done():
+                    job.future.set_exception(WorkError(f"engine failed: {e!r}"))
+            self._jobs.clear()
+            raise
+
+    async def _engine_loop_inner(self) -> None:
+        while not self._closed:
+            self._gc_jobs()
+            if not self._jobs:
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    # A job may have landed exactly at the deadline (set()
+                    # and the timeout can race); only die truly idle.
+                    if not self._jobs:
+                        return
+                continue
+            active = [j for j in self._jobs.values() if not j.cancelled][: self.max_batch]
+            if not active:
+                continue
+            params = self._pack(active)
+            offsets = await asyncio.to_thread(self._launch, params)
+            for job, off in zip(active, offsets[: len(active)]):
+                off = int(off)
+                self.total_hashes += self.chunk if off == int(search.SENTINEL) else off + 1
+                job.hashes_done += self.chunk
+                if job.future.done():
+                    continue  # cancelled while the chunk was in flight: drop
+                if off == int(search.SENTINEL):
+                    job.base = (job.base + self.chunk) & ((1 << 64) - 1)
+                    continue
+                nonce = search.nonce_from_offset(job.base, off)
+                work = search.work_hex_from_nonce(nonce)
+                try:
+                    nc.validate_work(job.request.block_hash, work, job.request.difficulty)
+                except nc.InvalidWork as e:  # device/host disagreement: fatal bug
+                    job.future.set_exception(WorkError(f"device produced invalid work: {e}"))
+                    continue
+                self.total_solutions += 1
+                job.future.set_result(work)
+
+    def _gc_jobs(self) -> None:
+        for key in [k for k, j in self._jobs.items() if j.future.done()]:
+            del self._jobs[key]

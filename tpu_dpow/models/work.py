@@ -94,8 +94,8 @@ class DifficultyModel:
         """Resolve a service request's difficulty/multiplier fields → u64.
 
         Mirrors reference dpow_server.py:250-282: explicit difficulty wins
-        over multiplier; both are clamped by max_multiplier; absent both,
-        the base difficulty applies.
+        over multiplier; both are validated against max_multiplier (out of
+        range raises InvalidMultiplier); absent both, the base applies.
         """
         if difficulty_hex is not None:
             difficulty = int(nc.validate_difficulty(difficulty_hex), 16)

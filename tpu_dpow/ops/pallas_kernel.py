@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the Blake2b nonce search.
+
+Hand-tiled version of ops/search.py's chunk scan for the TPU VPU:
+
+  * a (sublanes, 128) tile of uint32 lanes, each lane testing one nonce per
+    inner iteration — all 64-bit words live as (lo, hi) uint32 register pairs
+    (ops/u64.py), so one tile evaluates sublanes*128 blake2b compressions in
+    parallel on the 8x128 vector unit;
+  * an inner ``fori_loop`` strides the tile across ``iters`` consecutive
+    offset blocks, so one launch covers sublanes * 128 * iters nonces with a
+    single kernel dispatch (dispatch overhead is the enemy of the <50 ms p50
+    target — SURVEY.md §7 hard part #3);
+  * a found-flag early exit: once any lane hits, remaining iterations take
+    the cheap branch of a ``lax.cond`` and the launch drains fast — the
+    in-kernel analog of the reference's MQTT cancel fan-out (reference
+    server/dpow_server.py:155).
+
+Scalar parameters (message words, difficulty, base) ride in SMEM; the single
+uint32 result (first valid offset, or SENTINEL) comes back through SMEM too —
+no HBM traffic in the steady state, the kernel is pure VPU compute. The same
+kernel body runs in interpreter mode on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import blake2b
+from .search import PARAMS_LEN, SENTINEL, BASE_LO, BASE_HI, DIFF_LO, DIFF_HI
+
+# Default launch geometry: 32 sublanes x 128 lanes x 256 iters = 2^20 nonces
+# per launch. bench.py tunes this on real hardware; the backend overrides.
+DEFAULT_SUBLANES = 32
+DEFAULT_ITERS = 256
+
+
+# Mosaic has no unsigned min-reduction, so the in-kernel winner reduction
+# runs in int32: offsets are < 2^31 by the launch-size cap, and INT32_MAX
+# stands in for "not found" until converted back to the uint32 SENTINEL.
+_NOT_FOUND_I32 = np.int32(0x7FFFFFFF)
+
+
+def _search_core(get_param, sublanes: int, iters: int) -> jnp.ndarray:
+    """Shared kernel body: scan sublanes*128*iters offsets → best offset."""
+    tile = sublanes * 128
+    if tile * iters >= 1 << 31:
+        raise ValueError("launch window must stay below 2^31 nonces")
+    lane = (
+        lax.broadcasted_iota(jnp.uint32, (sublanes, 128), 0) * np.uint32(128)
+        + lax.broadcasted_iota(jnp.uint32, (sublanes, 128), 1)
+    )
+    msg = [get_param(i) for i in range(8)]
+    diff = (get_param(DIFF_LO), get_param(DIFF_HI))
+    base_lo = get_param(BASE_LO)
+    base_hi = get_param(BASE_HI)
+
+    def scan_block(k, best):
+        def compute(_):
+            offset = lane + (k * np.int32(tile)).astype(jnp.uint32)
+            lo = base_lo + offset
+            carry = (lo < base_lo).astype(jnp.uint32)
+            hi = base_hi + carry
+            ok = blake2b.pow_meets_difficulty((lo, hi), msg, diff)
+            return jnp.min(jnp.where(ok, offset.astype(jnp.int32), _NOT_FOUND_I32))
+
+        # Early exit: after a hit, every remaining iteration is a no-op.
+        return lax.cond(best == _NOT_FOUND_I32, compute, lambda _: best, None)
+
+    best = lax.fori_loop(0, iters, scan_block, _NOT_FOUND_I32)
+    return jnp.where(best == _NOT_FOUND_I32, SENTINEL, best.astype(jnp.uint32))
+
+
+def _kernel_single(params_ref, out_ref, *, sublanes: int, iters: int):
+    out_ref[0] = _search_core(lambda i: params_ref[i], sublanes, iters)
+
+
+def _kernel_batched(params_ref, out_ref, *, sublanes: int, iters: int):
+    # The whole (B, 12) params array and (B, 1) output live unblocked in
+    # SMEM (Mosaic rejects sub-8x128 block tiles even there); each
+    # sequential grid step indexes its own row by program_id.
+    b = pl.program_id(0)
+    out_ref[b, 0] = _search_core(lambda i: params_ref[b, i], sublanes, iters)
+
+
+@functools.partial(jax.jit, static_argnames=("sublanes", "iters", "interpret"))
+def pallas_search_chunk(
+    params: jnp.ndarray,
+    *,
+    sublanes: int = DEFAULT_SUBLANES,
+    iters: int = DEFAULT_ITERS,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One kernel launch scanning sublanes*128*iters nonces from params' base.
+
+    Same contract as ops/search.py::search_chunk: returns the lowest valid
+    offset as uint32, or SENTINEL if the window holds no solution.
+    """
+    kernel = functools.partial(_kernel_single, sublanes=sublanes, iters=iters)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        interpret=interpret,
+    )(params)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("sublanes", "iters", "interpret"))
+def pallas_search_chunk_batch(
+    params_batch: jnp.ndarray,
+    *,
+    sublanes: int = DEFAULT_SUBLANES,
+    iters: int = DEFAULT_ITERS,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched launch: uint32[B, 12] → uint32[B], one grid step per request.
+
+    Batching concurrent requests into a single fixed-shape launch (padded
+    slots get masked upstream by the backend) replaces the reference's
+    one-item-at-a-time POSTs to the native worker
+    (reference client/work_handler.py:98-108) without recompiles.
+    """
+    b = params_batch.shape[0]
+    kernel = functools.partial(_kernel_batched, sublanes=sublanes, iters=iters)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1), jnp.uint32),
+        grid=(b,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        interpret=interpret,
+    )(params_batch)[:, 0]
+
+
+def chunk_size(sublanes: int = DEFAULT_SUBLANES, iters: int = DEFAULT_ITERS) -> int:
+    return sublanes * 128 * iters

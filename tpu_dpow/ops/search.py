@@ -1,0 +1,100 @@
+"""Nonce-space search over the Nano PoW predicate — jnp reference path.
+
+This is the TPU-native replacement for the hot loop of the vendored
+``nano-work-server`` binary (reference client/bin, launched per
+client/README.md:31): scan 8-byte nonces until
+``blake2b_8(nonce_le || hash) >= difficulty``.
+
+Two device paths share this module's conventions:
+  * the pure-jnp chunk scanner below — runs anywhere JAX runs (the CPU
+    fallback/test backend the reference never had), and is also the
+    building block the shard_map multi-chip path wraps;
+  * the Pallas TPU kernel (ops/pallas_kernel.py) — same contract, hand-tiled
+    for the VPU with an in-kernel found-flag early exit.
+
+Contract for one chunk launch:
+  inputs : params uint32[12] =
+           [m1lo m1hi m2lo m2hi m3lo m3hi m4lo m4hi  diff_lo diff_hi  base_lo base_hi]
+  output : uint32 offset of the first (lowest-offset) valid nonce in
+           [base, base + chunk), or SENTINEL (0xFFFFFFFF) if none.
+
+The host loop (backend/jax_backend.py) re-launches chunks with advancing
+bases until a hit or a cancel — chunked launches are how a SIMD machine gets
+early exit and cancellation (SURVEY.md §7 hard part #2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import blake2b
+from .u64 import U64
+
+SENTINEL = np.uint32(0xFFFFFFFF)
+
+# params vector layout indices
+MSG_SLICE = slice(0, 8)
+DIFF_LO, DIFF_HI = 8, 9
+BASE_LO, BASE_HI = 10, 11
+PARAMS_LEN = 12
+
+
+def pack_params(block_hash: bytes, difficulty: int, base: int) -> np.ndarray:
+    """Host-side prep of one chunk launch's scalar parameters."""
+    out = np.empty(PARAMS_LEN, dtype=np.uint32)
+    out[MSG_SLICE] = blake2b.hash_to_message_words(block_hash)
+    out[DIFF_LO] = difficulty & 0xFFFFFFFF
+    out[DIFF_HI] = (difficulty >> 32) & 0xFFFFFFFF
+    out[BASE_LO] = base & 0xFFFFFFFF
+    out[BASE_HI] = (base >> 32) & 0xFFFFFFFF
+    return out
+
+
+def chunk_offsets_ok(params: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+    """Predicate for nonce = base + offset, any offset array shape."""
+    base_lo = params[BASE_LO]
+    base_hi = params[BASE_HI]
+    lo = base_lo + offsets
+    carry = (lo < base_lo).astype(jnp.uint32)
+    hi = base_hi + carry
+    msg = [params[i] for i in range(8)]
+    diff: U64 = (params[DIFF_LO], params[DIFF_HI])
+    return blake2b.pow_meets_difficulty((lo, hi), msg, diff)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def search_chunk(params: jnp.ndarray, *, chunk_size: int) -> jnp.ndarray:
+    """Scan [base, base + chunk_size) in one fused launch → first valid offset.
+
+    chunk_size must be < 2**32 (offsets are uint32); in practice it is a
+    multiple of 1024 to fill (8, 128) VPU tiles.
+    """
+    offsets = jnp.arange(chunk_size, dtype=jnp.uint32)
+    ok = chunk_offsets_ok(params, offsets)
+    return jnp.min(jnp.where(ok, offsets, SENTINEL))
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def search_chunk_batch(params_batch: jnp.ndarray, *, chunk_size: int) -> jnp.ndarray:
+    """vmapped chunk scan over a batch of requests: uint32[B,12] → uint32[B].
+
+    Batching concurrent (hash, difficulty) requests into one launch is the
+    rebuild's replacement for the reference's one-work-item-at-a-time POST
+    to the native worker (reference client/work_handler.py:98-108);
+    cancelled requests are masked by giving them an impossible difficulty
+    (all-ones) rather than re-tracing a smaller batch.
+    """
+    return jax.vmap(lambda p: search_chunk(p, chunk_size=chunk_size))(params_batch)
+
+
+def nonce_from_offset(base: int, offset: int) -> int:
+    return (base + offset) & 0xFFFFFFFFFFFFFFFF
+
+
+def work_hex_from_nonce(nonce: int) -> str:
+    """Nano's work field: the u64 nonce rendered as 16 big-endian hex chars."""
+    return f"{nonce:016x}"

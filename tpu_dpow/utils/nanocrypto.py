@@ -177,7 +177,12 @@ def decode_account(account: str) -> bytes:
     if len(body) != 60:
         raise InvalidAccount(f"account body must be 60 chars, got {len(body)}")
     raw = _b32_decode(body[:52], 260)
-    if raw[0] & 0xF0:
+    # 260 bits in a 33-byte container: the 4 pad bits are bits 256..259 —
+    # the LOW nibble of byte 0 (the high nibble is structurally zero).
+    # Rejecting nonzero padding makes the address encoding canonical: without
+    # it every public key has 16 accepted spellings, and payout accounting
+    # keyed on the address string could be split across aliases.
+    if raw[0] & 0x0F:
         raise InvalidAccount("invalid account: nonzero padding bits")
     pubkey = raw[1:]
     if _b32_decode(body[52:], 40) != _checksum(pubkey):
