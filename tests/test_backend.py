@@ -126,6 +126,48 @@ def test_engine_restarts_after_idle():
     asyncio.run(run())
 
 
+def test_waiter_timeout_does_not_spin_engine(backend):
+    # Regression: a waiter abandoning via wait_for timeout left a job that
+    # was neither done nor active, and the engine busy-spun on it.
+    async def run():
+        await backend.setup()
+        hard = nc.derive_work_difficulty(4.0)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                backend.generate(WorkRequest(random_hash(), hard)), timeout=0.3
+            )
+        # The event loop must still be responsive and the job gone.
+        t0 = asyncio.get_running_loop().time()
+        await asyncio.sleep(0.05)
+        assert asyncio.get_running_loop().time() - t0 < 1.0
+        for _ in range(100):
+            if not backend._jobs:
+                break
+            await asyncio.sleep(0.02)
+        assert not backend._jobs
+        await backend.close()
+
+    asyncio.run(run())
+
+
+def test_dedup_upgrades_difficulty(backend):
+    # Regression: a second request for the same hash at a HIGHER difficulty
+    # must not be satisfied by weaker work.
+    async def run():
+        await backend.setup()
+        h = random_hash()
+        low, high = 0xF000000000000000, EASY  # EASY is stricter than low
+        t1 = asyncio.ensure_future(backend.generate(WorkRequest(h, low)))
+        await asyncio.sleep(0)
+        t2 = asyncio.ensure_future(backend.generate(WorkRequest(h, high)))
+        w1, w2 = await asyncio.gather(t1, t2)
+        assert w1 == w2
+        nc.validate_work(h, w2, high)  # meets the stronger target
+        await backend.close()
+
+    asyncio.run(run())
+
+
 def test_registry():
     assert isinstance(get_backend("jax", kernel="xla"), JaxWorkBackend)
     with pytest.raises(ValueError):

@@ -1,0 +1,143 @@
+"""MemoryStore: TTLs (deterministic clock), setnx lock, snapshot/restore."""
+
+import asyncio
+
+import pytest
+
+from tpu_dpow.store import MemoryStore, get_store
+
+
+class Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_get_set_delete():
+    async def main():
+        s = MemoryStore()
+        assert await s.get("a") is None
+        await s.set("a", "1")
+        assert await s.get("a") == "1"
+        assert await s.exists("a")
+        assert await s.delete("a", "missing") == 1
+        assert not await s.exists("a")
+
+    run(main())
+
+
+def test_ttl_expiry_deterministic():
+    clock = Clock()
+
+    async def main():
+        s = MemoryStore(clock=clock)
+        await s.set("block:X", "work", expire=120)
+        clock.now = 119.9
+        assert await s.get("block:X") == "work"
+        clock.now = 120.1
+        assert await s.get("block:X") is None
+        # set without expire clears a previous TTL
+        await s.set("k", "v", expire=10)
+        await s.set("k", "v2")
+        clock.now = 1000
+        assert await s.get("k") == "v2"
+
+    run(main())
+
+
+def test_setnx_winner_election():
+    clock = Clock()
+
+    async def main():
+        s = MemoryStore(clock=clock)
+        # Two clients race to claim the same block (reference dpow_server.py:138)
+        first = await s.setnx("block-lock:H", "client-a", expire=5)
+        second = await s.setnx("block-lock:H", "client-b", expire=5)
+        assert first and not second
+        assert await s.get("block-lock:H") == "client-a"
+        clock.now = 6
+        # lock expired → claimable again
+        assert await s.setnx("block-lock:H", "client-b", expire=5)
+
+    run(main())
+
+
+def test_counters_and_hashes():
+    async def main():
+        s = MemoryStore()
+        assert await s.incrby("n") == 1
+        assert await s.incrby("n", 5) == 6
+        await s.hset("client:addr", {"precache": "0"})
+        assert await s.hincrby("client:addr", "precache") == 1
+        assert await s.hincrby("client:addr", "ondemand", 3) == 3
+        assert await s.hget("client:addr", "precache") == "1"
+        assert await s.hgetall("client:addr") == {"precache": "1", "ondemand": "3"}
+
+    run(main())
+
+
+def test_sets_and_keys():
+    async def main():
+        s = MemoryStore()
+        await s.sadd("clients", "a", "b")
+        await s.sadd("clients", "b", "c")
+        assert await s.smembers("clients") == {"a", "b", "c"}
+        await s.srem("clients", "b")
+        assert await s.smembers("clients") == {"a", "c"}
+        await s.set("service:one", "x")
+        await s.set("service:two", "y")
+        assert sorted(await s.keys("service:*")) == ["service:one", "service:two"]
+
+    run(main())
+
+
+def test_type_mismatch_raises():
+    async def main():
+        s = MemoryStore()
+        await s.set("k", "v")
+        with pytest.raises(TypeError):
+            await s.hget("k", "f")
+
+    run(main())
+
+
+def test_snapshot_restore_preserves_ttl(tmp_path):
+    clock = Clock()
+
+    async def main():
+        s = MemoryStore(clock=clock)
+        await s.set("block:A", "deadbeef", expire=100)
+        await s.set("perm", "keep")
+        await s.hset("client:x", {"ondemand": "7"})
+        await s.sadd("clients", "x")
+        clock.now = 40.0
+        path = str(tmp_path / "snap.json")
+        s.save(path)
+
+        clock2 = Clock()
+        clock2.now = 500.0  # restore into a process with a different clock base
+        s2 = MemoryStore(clock=clock2)
+        s2.load(path)
+        assert await s2.get("block:A") == "deadbeef"
+        assert await s2.hgetall("client:x") == {"ondemand": "7"}
+        assert await s2.smembers("clients") == {"x"}
+        clock2.now = 500.0 + 59.9  # 60s TTL remained at snapshot time
+        assert await s2.get("block:A") == "deadbeef"
+        clock2.now = 500.0 + 60.1
+        assert await s2.get("block:A") is None
+        assert await s2.get("perm") == "keep"
+
+    run(main())
+
+
+def test_get_store_factory():
+    assert isinstance(get_store(), MemoryStore)
+    assert isinstance(get_store("memory"), MemoryStore)
+    with pytest.raises(ValueError):
+        get_store("mongodb://nope")

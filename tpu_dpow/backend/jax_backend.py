@@ -43,15 +43,28 @@ from ..utils import nanocrypto as nc
 from . import WorkBackend, WorkCancelled, WorkError
 
 _UNREACHABLE = (1 << 64) - 1  # padding difficulty: P(hit) = 2^-64 per hash
+_MASK64 = (1 << 64) - 1
 
 
 @dataclass
 class _Job:
-    request: WorkRequest
-    base: int
+    block_hash: str
+    difficulty: int  # current target; can only be raised by a later request
+    params: np.ndarray  # cached uint32[12] row; base/diff words updated in place
     future: asyncio.Future
+    base: int
     cancelled: bool = False
     hashes_done: int = 0
+
+    def set_base(self, base: int) -> None:
+        self.base = base & _MASK64
+        self.params[search.BASE_LO] = self.base & 0xFFFFFFFF
+        self.params[search.BASE_HI] = self.base >> 32
+
+    def set_difficulty(self, difficulty: int) -> None:
+        self.difficulty = difficulty
+        self.params[search.DIFF_LO] = difficulty & 0xFFFFFFFF
+        self.params[search.DIFF_HI] = difficulty >> 32
 
 
 class JaxWorkBackend(WorkBackend):
@@ -101,29 +114,40 @@ class JaxWorkBackend(WorkBackend):
             raise WorkError("backend closed")
         key = request.block_hash
         existing = self._jobs.get(key)
-        if existing is not None and not existing.cancelled:
+        if existing is not None and not existing.cancelled and not existing.future.done():
             # Dedup concurrent generates for the same hash (the reference
-            # dedups on enqueue, client/work_handler.py:84-89).
+            # dedups on enqueue, client/work_handler.py:84-89). A stronger
+            # difficulty raises the shared job's target: the eventual nonce
+            # then satisfies every waiter; a weaker/equal one just shares.
+            if request.difficulty > existing.difficulty:
+                existing.set_difficulty(request.difficulty)
             return await asyncio.shield(existing.future)
         job = _Job(
-            request=request,
-            base=secrets.randbits(64),
+            block_hash=key,
+            difficulty=request.difficulty,
+            params=search.pack_params(request.hash_bytes, request.difficulty, 0),
             future=asyncio.get_running_loop().create_future(),
+            base=0,
         )
+        job.set_base(secrets.randbits(64))
         self._jobs[key] = job
         self._ensure_engine()
         self._wakeup.set()
         try:
             return await asyncio.shield(job.future)
         except asyncio.CancelledError:
+            # Waiter gave up (e.g. wait_for timeout): finish the job as
+            # cancelled so the engine can drop it instead of spinning on it.
             job.cancelled = True
+            if not job.future.done():
+                job.future.cancel()
             raise
 
     async def cancel(self, block_hash: str) -> None:
         job = self._jobs.get(nc.validate_block_hash(block_hash))
         if job is not None and not job.future.done():
             job.cancelled = True
-            job.future.set_exception(WorkCancelled(job.request.block_hash))
+            job.future.set_exception(WorkCancelled(job.block_hash))
 
     async def close(self) -> None:
         self._closed = True
@@ -156,21 +180,19 @@ class JaxWorkBackend(WorkBackend):
             out = search.search_chunk_batch(pj, chunk_size=self.chunk)
         return np.asarray(out)
 
+    _PAD_ROW = None  # lazily built unreachable-difficulty padding row
+
     def _pack(self, jobs: list) -> np.ndarray:
         """Fixed-shape batch: active jobs + unreachable-difficulty padding."""
         b = 1
         while b < len(jobs):
             b *= 2
         b = min(max(b, 1), self.max_batch)
+        if JaxWorkBackend._PAD_ROW is None:
+            JaxWorkBackend._PAD_ROW = search.pack_params(bytes(32), _UNREACHABLE, 0)
         out = np.empty((b, search.PARAMS_LEN), dtype=np.uint32)
         for i in range(b):
-            if i < len(jobs):
-                job = jobs[i]
-                out[i] = search.pack_params(
-                    job.request.hash_bytes, job.request.difficulty, job.base
-                )
-            else:
-                out[i] = search.pack_params(bytes(32), _UNREACHABLE, 0)
+            out[i] = jobs[i].params if i < len(jobs) else JaxWorkBackend._PAD_ROW
         return out
 
     async def _engine_loop(self) -> None:
@@ -199,27 +221,40 @@ class JaxWorkBackend(WorkBackend):
                 continue
             active = [j for j in self._jobs.values() if not j.cancelled][: self.max_batch]
             if not active:
+                await asyncio.sleep(0)  # cancelled stragglers gc'd next pass
                 continue
             params = self._pack(active)
+            # Snapshot each job's target at launch: a concurrent dedup may
+            # raise job.difficulty while this chunk is in flight.
+            launched_difficulty = [j.difficulty for j in active]
             offsets = await asyncio.to_thread(self._launch, params)
-            for job, off in zip(active, offsets[: len(active)]):
+            for job, launched, off in zip(active, launched_difficulty, offsets[: len(active)]):
                 off = int(off)
                 self.total_hashes += self.chunk if off == int(search.SENTINEL) else off + 1
                 job.hashes_done += self.chunk
                 if job.future.done():
                     continue  # cancelled while the chunk was in flight: drop
                 if off == int(search.SENTINEL):
-                    job.base = (job.base + self.chunk) & ((1 << 64) - 1)
+                    job.set_base(job.base + self.chunk)
                     continue
                 nonce = search.nonce_from_offset(job.base, off)
                 work = search.work_hex_from_nonce(nonce)
-                try:
-                    nc.validate_work(job.request.block_hash, work, job.request.difficulty)
-                except nc.InvalidWork as e:  # device/host disagreement: fatal bug
-                    job.future.set_exception(WorkError(f"device produced invalid work: {e}"))
-                    continue
-                self.total_solutions += 1
-                job.future.set_result(work)
+                value = nc.work_value(job.block_hash, work)
+                if value >= job.difficulty:
+                    self.total_solutions += 1
+                    job.future.set_result(work)
+                elif value >= launched:
+                    # Valid for the difficulty this chunk was launched at,
+                    # but the target was raised mid-flight: keep searching
+                    # past this nonce at the new difficulty.
+                    job.set_base(nonce + 1)
+                else:  # device/host disagreement: a real bug, surface it
+                    job.future.set_exception(
+                        WorkError(
+                            f"device produced invalid work {work} for "
+                            f"{job.block_hash} (value {value:016x} < {launched:016x})"
+                        )
+                    )
 
     def _gc_jobs(self) -> None:
         for key in [k for k, j in self._jobs.items() if j.future.done()]:

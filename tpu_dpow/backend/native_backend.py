@@ -1,0 +1,23 @@
+"""C++ CPU work backend via ctypes — placeholder until native/ lands.
+
+Will load ``native/libblake2b_worker.so`` (multithreaded CPU nonce search,
+the analog of the reference's nano-work-server CPU mode) through ctypes.
+"""
+
+from __future__ import annotations
+
+from . import WorkBackend, WorkError
+
+
+class NativeWorkBackend(WorkBackend):  # pragma: no cover - placeholder
+    def __init__(self, **kwargs):
+        raise WorkError(
+            "the native C++ backend is not built yet; use backend='jax' "
+            "(TPU/CPU via JAX) or backend='subprocess' (external work server)"
+        )
+
+    async def setup(self) -> None: ...
+
+    async def generate(self, request) -> str: ...
+
+    async def cancel(self, block_hash: str) -> None: ...

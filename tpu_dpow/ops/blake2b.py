@@ -136,24 +136,7 @@ def pow_work_value(nonce: U64, msg_words: Sequence[jnp.ndarray]) -> U64:
     m.extend([zero] * 11)
 
     h: List[U64] = [u64.from_int(H0_POW)] + [u64.from_int(IV[i]) for i in range(1, 8)]
-
-    # Inline single-block compression; only h[0] is needed, but computing the
-    # full working vector is unavoidable (every v word feeds the rounds).
-    v: List[U64] = list(h) + [u64.from_int(IV[i]) for i in range(8)]
-    v[12] = u64.xor(v[12], u64.from_int(POW_MESSAGE_LEN))
-    v[14] = u64.xor(v[14], u64.from_int(0xFFFFFFFFFFFFFFFF))
-    for r in range(12):
-        s = SIGMA[r]
-        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
-        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
-        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
-        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
-        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
-        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
-        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
-        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
-    h0 = u64.from_int(H0_POW)
-    return u64.xor(u64.xor(h0, v[0]), v[8])
+    return compress(h, m, POW_MESSAGE_LEN, final=True)[0]
 
 
 def pow_meets_difficulty(

@@ -1,0 +1,81 @@
+"""Redis-backed Store, import-gated on the ``redis`` package.
+
+Deployment parity with the reference's aioredis pool (reference
+server/dpow/redis_db.py:12-16): same operation surface as MemoryStore, so the
+server code is oblivious to which one it got. This environment has no redis
+package installed, so this module is exercised only where one is.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+try:
+    import redis.asyncio as aredis
+except ImportError as e:  # pragma: no cover - environment-dependent
+    raise ImportError(
+        "RedisStore requires the 'redis' package (pip install redis)"
+    ) from e
+
+from . import Store
+
+
+class RedisStore(Store):  # pragma: no cover - needs a live redis server
+    def __init__(self, uri: str = "redis://localhost", *, pool_size: int = 15):
+        self._uri = uri
+        self._pool_size = pool_size
+        self._redis = None
+
+    async def setup(self) -> None:
+        self._redis = aredis.from_url(
+            self._uri, max_connections=self._pool_size, decode_responses=True
+        )
+        await self._redis.ping()
+
+    async def close(self) -> None:
+        if self._redis is not None:
+            await self._redis.aclose()
+            self._redis = None
+
+    async def get(self, key: str) -> Optional[str]:
+        return await self._redis.get(key)
+
+    async def set(self, key: str, value: str, expire: Optional[float] = None) -> None:
+        await self._redis.set(key, value, ex=int(expire) if expire else None)
+
+    async def setnx(self, key: str, value: str, expire: Optional[float] = None) -> bool:
+        ok = await self._redis.set(key, value, nx=True, ex=int(expire) if expire else None)
+        return bool(ok)
+
+    async def delete(self, *keys: str) -> int:
+        return await self._redis.delete(*keys)
+
+    async def exists(self, key: str) -> bool:
+        return bool(await self._redis.exists(key))
+
+    async def incrby(self, key: str, amount: int = 1) -> int:
+        return await self._redis.incrby(key, amount)
+
+    async def hset(self, key: str, mapping: Dict[str, str]) -> None:
+        await self._redis.hset(key, mapping=mapping)
+
+    async def hget(self, key: str, field: str) -> Optional[str]:
+        return await self._redis.hget(key, field)
+
+    async def hgetall(self, key: str) -> Dict[str, str]:
+        return await self._redis.hgetall(key)
+
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        return await self._redis.hincrby(key, field, amount)
+
+    async def sadd(self, key: str, *members: str) -> None:
+        await self._redis.sadd(key, *members)
+
+    async def srem(self, key: str, *members: str) -> None:
+        await self._redis.srem(key, *members)
+
+    async def smembers(self, key: str) -> set:
+        return set(await self._redis.smembers(key))
+
+    async def keys(self, pattern: str = "*") -> list:
+        return await self._redis.keys(pattern)

@@ -1,0 +1,132 @@
+"""Pub/sub transport: the rebuild's Mosquitto seam.
+
+The reference's data plane is MQTT over an external Mosquitto broker with a
+password file and an ACL matrix (reference server/setup/mosquitto/dpow.conf,
+acls:1-33; topic contract in docs/specification.md:5-15). This environment
+has neither Mosquitto nor an MQTT client library, so the rebuild ships its
+own transport with the same semantics behind an injectable interface:
+
+  * MQTT-style topic trees with ``+`` (one level) and ``#`` (rest) wildcards;
+  * QoS 0 (at-most-once) and QoS 1 (at-least-once: broker-side per-client
+    session queues replayed on reconnect — the property the reference relies
+    on by subscribing ``cancel/{type}`` and ``client/{payout}`` at QOS_1
+    with cleansession=False, reference client/dpow_client.py:109,143-147);
+  * username/password auth with per-user publish/subscribe ACL patterns
+    (mirroring the dpowserver/client/dpowinterface matrix);
+  * 1 Hz broker-relayed server heartbeat (reference server/dpow/mqtt.py:76-89).
+
+Implementations: in-process (tests, single-process deployments) and TCP
+(JSON-lines framing, multi-host). A real MQTT broker can be slotted back in
+by implementing Transport against any client library.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional
+
+QOS_0 = 0
+QOS_1 = 1
+
+
+@dataclass(frozen=True)
+class Message:
+    topic: str
+    payload: str
+    qos: int = QOS_0
+
+
+class TransportError(Exception):
+    pass
+
+
+class AuthError(TransportError):
+    pass
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT matching: '+' = exactly one level, '#' = all remaining levels."""
+    p_levels = pattern.split("/")
+    t_levels = topic.split("/")
+    for i, p in enumerate(p_levels):
+        if p == "#":
+            return True
+        if i >= len(t_levels):
+            return False
+        if p != "+" and p != t_levels[i]:
+            return False
+    return len(p_levels) == len(t_levels)
+
+
+class Transport(abc.ABC):
+    """One endpoint's connection to the broker."""
+
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def publish(self, topic: str, payload: str, qos: int = QOS_0) -> None: ...
+
+    @abc.abstractmethod
+    async def subscribe(self, pattern: str, qos: int = QOS_0) -> None: ...
+
+    @abc.abstractmethod
+    async def messages(self) -> AsyncIterator[Message]:
+        """Async iterator over inbound messages for this endpoint's
+        subscriptions (the reference's message_receive_loop analog,
+        server/dpow/mqtt.py:54-74)."""
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    @property
+    @abc.abstractmethod
+    def connected(self) -> bool: ...
+
+
+@dataclass
+class User:
+    """Broker account with mosquitto-style ACL patterns."""
+
+    password: str
+    acl_pub: tuple = ("#",)
+    acl_sub: tuple = ("#",)
+
+    def may_publish(self, topic: str) -> bool:
+        return any(topic_matches(p, topic) for p in self.acl_pub)
+
+    def may_subscribe(self, pattern: str) -> bool:
+        # A subscription is allowed if it is no broader than some ACL grant:
+        # exact containment is undecidable cheaply, so (like mosquitto) we
+        # check the pattern itself against the grants treating the
+        # subscription as a topic with wildcards intact, plus the common
+        # case of subscribing exactly an allowed pattern.
+        return any(
+            p == pattern or topic_matches(p, pattern) or topic_matches(pattern, p)
+            for p in self.acl_sub
+        )
+
+
+# The reference's ACL matrix (server/setup/mosquitto/acls:1-33), transcribed:
+# the server writes work/cancel/heartbeat/statistics/client-stats and reads
+# results; clients the inverse; the dashboard user reads everything public.
+def default_users(server_password: str = "dpowserver", client_password: str = "client") -> dict:
+    return {
+        "dpowserver": User(
+            password=server_password,
+            acl_pub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#", "priority/#"),
+            acl_sub=("result/#", "get_info/#"),
+        ),
+        "client": User(
+            password=client_password,
+            acl_pub=("result/#", "get_info/#"),
+            acl_sub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#", "priority/#"),
+        ),
+        "dpowinterface": User(
+            password="dpowinterface",
+            acl_pub=(),
+            acl_sub=("statistics", "client/#", "heartbeat"),
+        ),
+    }

@@ -1,0 +1,138 @@
+"""Broker core: sessions, subscriptions, QoS-1 queues — transport-agnostic.
+
+One Broker instance serves both the in-process endpoints (transport/inproc.py)
+and TCP connections (transport/tcp.py); a deployment can mix them, e.g. the
+server attached in-process and remote workers over TCP.
+
+Session semantics follow what the reference depends on from Mosquitto:
+  * clean_session=False retains a client's subscriptions and queues its
+    QoS-1 messages while it is disconnected, replaying them on reconnect
+    (reference client/dpow_client.py:109 relies on this for cancel/# and
+    client/# delivery across drops);
+  * QoS 0 messages to disconnected sessions are dropped;
+  * per-session inbound queues are bounded — overflow drops oldest QoS-0
+    first (a slow consumer must not wedge the broker).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from . import AuthError, Message, QOS_1, TransportError, User, topic_matches
+
+MAX_QUEUE = 10_000
+MAX_OFFLINE_QUEUE = 1_000
+
+
+@dataclass
+class Session:
+    client_id: str
+    username: str
+    clean: bool
+    subscriptions: Dict[str, int] = field(default_factory=dict)  # pattern → qos
+    queue: Optional[asyncio.Queue] = None  # None while disconnected
+    offline: list = field(default_factory=list)  # queued QoS-1 while offline
+    connected_at: float = field(default_factory=time.monotonic)
+
+    def matches(self, topic: str) -> Optional[int]:
+        """Highest QoS among matching subscriptions, or None."""
+        best = None
+        for pattern, qos in self.subscriptions.items():
+            if topic_matches(pattern, topic):
+                best = qos if best is None else max(best, qos)
+        return best
+
+
+class Broker:
+    """Topic router with auth, ACLs and persistent sessions."""
+
+    def __init__(self, users: Optional[Dict[str, User]] = None):
+        self.users = users  # None → open broker (tests)
+        self.sessions: Dict[str, Session] = {}
+        self.stats = {"published": 0, "delivered": 0, "dropped": 0, "denied": 0}
+
+    # -- connection lifecycle -----------------------------------------
+
+    def authenticate(self, username: str, password: str) -> User:
+        if self.users is None:
+            return User(password="")
+        user = self.users.get(username)
+        if user is None or user.password != password:
+            raise AuthError(f"bad credentials for {username!r}")
+        return user
+
+    def attach(
+        self, client_id: str, username: str, password: str, clean_session: bool = True
+    ) -> Session:
+        self.authenticate(username, password)
+        session = self.sessions.get(client_id)
+        if session is None or clean_session or session.clean:
+            session = Session(client_id=client_id, username=username, clean=clean_session)
+            self.sessions[client_id] = session
+        session.username = username
+        session.queue = asyncio.Queue(maxsize=MAX_QUEUE)
+        # Replay QoS-1 messages queued while this session was offline.
+        for msg in session.offline:
+            self._enqueue(session, msg)
+        session.offline.clear()
+        return session
+
+    def detach(self, session: Session) -> None:
+        session.queue = None
+        if session.clean:
+            self.sessions.pop(session.client_id, None)
+
+    # -- pub/sub -------------------------------------------------------
+
+    def user_for(self, session: Session) -> User:
+        if self.users is None:
+            return User(password="")
+        return self.users[session.username]
+
+    def subscribe(self, session: Session, pattern: str, qos: int) -> None:
+        if not self.user_for(session).may_subscribe(pattern):
+            self.stats["denied"] += 1
+            raise AuthError(f"{session.username!r} may not subscribe {pattern!r}")
+        session.subscriptions[pattern] = qos
+
+    def unsubscribe(self, session: Session, pattern: str) -> None:
+        session.subscriptions.pop(pattern, None)
+
+    def publish(self, session: Optional[Session], topic: str, payload: str, qos: int) -> None:
+        if session is not None and not self.user_for(session).may_publish(topic):
+            self.stats["denied"] += 1
+            raise AuthError(f"{session.username!r} may not publish to {topic!r}")
+        self.stats["published"] += 1
+        for target in list(self.sessions.values()):
+            sub_qos = target.matches(topic)
+            if sub_qos is None:
+                continue
+            # Effective QoS = min(publish qos, subscription qos), per MQTT.
+            eff = min(qos, sub_qos)
+            msg = Message(topic=topic, payload=payload, qos=eff)
+            if target.queue is None:
+                if eff >= QOS_1 and not target.clean:
+                    target.offline.append(msg)
+                    if len(target.offline) > MAX_OFFLINE_QUEUE:
+                        target.offline.pop(0)
+                        self.stats["dropped"] += 1
+                else:
+                    self.stats["dropped"] += 1
+                continue
+            self._enqueue(target, msg)
+
+    def _enqueue(self, target: Session, msg: Message) -> None:
+        try:
+            target.queue.put_nowait(msg)
+            self.stats["delivered"] += 1
+        except asyncio.QueueFull:
+            # Shed load: drop the oldest queued message to admit the new one.
+            try:
+                target.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            target.queue.put_nowait(msg)
+            self.stats["dropped"] += 1
