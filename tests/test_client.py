@@ -1,0 +1,282 @@
+"""WorkHandler queue discipline + DpowClient loop semantics."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from tpu_dpow.backend import WorkBackend, WorkCancelled, WorkError
+from tpu_dpow.client import ClientConfig, DpowClient, WorkHandler
+from tpu_dpow.models import WorkRequest, WorkType
+from tpu_dpow.transport import QOS_1
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(21)
+EASY = 0xF000000000000000
+PAYOUT = nc.encode_account(bytes(range(32)))
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=20))
+
+
+class ManualBackend(WorkBackend):
+    """Backend whose completions are driven explicitly by the test."""
+
+    def __init__(self):
+        self.futures = {}
+        self.cancelled = []
+        self.setup_called = False
+
+    async def setup(self):
+        self.setup_called = True
+
+    async def generate(self, request):
+        fut = asyncio.get_running_loop().create_future()
+        self.futures[request.block_hash] = fut
+        return await fut
+
+    async def cancel(self, block_hash):
+        self.cancelled.append(block_hash)
+        fut = self.futures.get(block_hash)
+        if fut and not fut.done():
+            fut.set_exception(WorkCancelled(block_hash))
+
+    def solve(self, block_hash, work="abcd"):
+        self.futures[block_hash].set_result(work)
+
+
+async def wait_until(pred, timeout=5):
+    for _ in range(int(timeout / 0.01)):
+        if pred():
+            return
+        await asyncio.sleep(0.01)
+    raise TimeoutError("condition not met")
+
+
+def test_handler_dedup_and_solve():
+    async def main():
+        backend = ManualBackend()
+        results = []
+
+        async def cb(req, work):
+            results.append((req.block_hash, work))
+
+        handler = WorkHandler(backend, cb, concurrency=2)
+        await handler.start()
+        assert backend.setup_called
+        h = random_hash()
+        req = WorkRequest(h, EASY)
+        await handler.queue_work(req)
+        await handler.queue_work(req)  # dup in queue or ongoing → dropped
+        await wait_until(lambda: h in backend.futures)
+        await handler.queue_work(req)  # dup while ongoing
+        assert handler.stats["deduped"] == 2
+        backend.solve(h, "beef")
+        await wait_until(lambda: results)
+        assert results == [(h, "beef")]
+        await handler.stop()
+
+    run(main())
+
+
+def test_handler_cancel_in_queue_vs_ongoing():
+    async def main():
+        backend = ManualBackend()
+        results = []
+
+        async def cb(req, work):
+            results.append(req.block_hash)
+
+        # concurrency=1 → second item stays queued while first is ongoing
+        handler = WorkHandler(backend, cb, concurrency=1)
+        await handler.start()
+        h1, h2 = random_hash(), random_hash()
+        await handler.queue_work(WorkRequest(h1, EASY))
+        await wait_until(lambda: h1 in backend.futures)
+        await handler.queue_work(WorkRequest(h2, EASY))
+        # cancel queued item: removed without touching the backend
+        await handler.queue_cancel(h2)
+        assert h2 not in backend.futures and h2 not in handler.queue
+        assert backend.cancelled == []
+        # cancel ongoing item: reaches the backend
+        await handler.queue_cancel(h1)
+        assert backend.cancelled == [h1]
+        await wait_until(lambda: not handler.ongoing)
+        assert results == []
+        await handler.stop()
+
+    run(main())
+
+
+def test_handler_completion_after_cancel_dropped():
+    async def main():
+        backend = ManualBackend()
+        results = []
+
+        async def cb(req, work):
+            results.append(req.block_hash)
+
+        handler = WorkHandler(backend, cb, concurrency=1)
+        await handler.start()
+        h = random_hash()
+        await handler.queue_work(WorkRequest(h, EASY))
+        await wait_until(lambda: h in backend.futures)
+        # Race: cancel wins the bookkeeping, then the solve lands anyway.
+        handler.ongoing.pop(h)  # simulate cancel's first step interleaving
+        backend.solve(h)
+        await asyncio.sleep(0.05)
+        assert results == []  # dropped, not reported
+        await handler.stop()
+
+    run(main())
+
+
+def test_handler_backend_error_does_not_kill_worker():
+    async def main():
+        backend = ManualBackend()
+        results = []
+
+        async def cb(req, work):
+            results.append(req.block_hash)
+
+        handler = WorkHandler(backend, cb, concurrency=1)
+        await handler.start()
+        h1, h2 = random_hash(), random_hash()
+        await handler.queue_work(WorkRequest(h1, EASY))
+        await wait_until(lambda: h1 in backend.futures)
+        backend.futures[h1].set_exception(WorkError("boom"))
+        await handler.queue_work(WorkRequest(h2, EASY))
+        await wait_until(lambda: h2 in backend.futures)
+        backend.solve(h2)
+        await wait_until(lambda: results)
+        assert results == [h2] and handler.stats["errors"] == 1
+        await handler.stop()
+
+    run(main())
+
+
+class ClientHarness:
+    def __init__(self, work_type=WorkType.ANY, heartbeat=True):
+        self.broker = Broker()
+        self.server_t = InProcTransport(self.broker, client_id="server")
+        self.backend = ManualBackend()
+        self.config = ClientConfig(
+            payout_address=PAYOUT,
+            work_type=work_type,
+            startup_heartbeat_wait=0.5,
+        )
+        self.client = DpowClient(
+            self.config,
+            InProcTransport(self.broker, client_id="worker", clean_session=False),
+            backend=self.backend,
+        )
+        self.heartbeat = heartbeat
+        self._hb_task = None
+        self.received = []
+
+    async def __aenter__(self):
+        await self.server_t.connect()
+        await self.server_t.subscribe("result/#")
+        if self.heartbeat:
+            async def hb():
+                while True:
+                    await self.server_t.publish("heartbeat", "")
+                    await asyncio.sleep(0.05)
+            self._hb_task = asyncio.ensure_future(hb())
+
+        async def collect():
+            async for m in self.server_t.messages():
+                self.received.append(m)
+        self._rx_task = asyncio.ensure_future(collect())
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._hb_task:
+            self._hb_task.cancel()
+        self._rx_task.cancel()
+        await self.client.close()
+        await self.server_t.close()
+
+
+def test_client_requires_heartbeat_to_start():
+    async def main():
+        async with ClientHarness(heartbeat=False) as hx:
+            with pytest.raises(ConnectionError, match="offline"):
+                await hx.client.setup()
+
+    run(main())
+
+
+def test_client_work_dispatch_and_result_roundtrip():
+    async def main():
+        async with ClientHarness() as hx:
+            await hx.client.setup()
+            hx.client.start_loops()
+            h = random_hash()
+            await hx.server_t.publish("work/ondemand", f"{h},{EASY:016x}")
+            await wait_until(lambda: h in hx.backend.futures)
+            hx.backend.solve(h, "1234567890abcdef")
+            await wait_until(lambda: hx.received)
+            msg = hx.received[0]
+            assert msg.topic == "result/ondemand"
+            assert msg.payload == f"{h},1234567890abcdef,{PAYOUT}"
+
+    run(main())
+
+
+def test_client_cancel_routed_to_handler():
+    async def main():
+        async with ClientHarness() as hx:
+            await hx.client.setup()
+            hx.client.start_loops()
+            h = random_hash()
+            await hx.server_t.publish("work/precache", f"{h},{EASY:016x}")
+            await wait_until(lambda: h in hx.backend.futures)
+            await hx.server_t.publish("cancel/precache", h, qos=QOS_1)
+            await wait_until(lambda: hx.backend.cancelled == [h])
+            assert not hx.received  # nothing published for cancelled work
+
+    run(main())
+
+
+def test_client_work_type_filtering():
+    async def main():
+        async with ClientHarness(work_type=WorkType.PRECACHE) as hx:
+            await hx.client.setup()
+            hx.client.start_loops()
+            h1, h2 = random_hash(), random_hash()
+            await hx.server_t.publish("work/ondemand", f"{h1},{EASY:016x}")
+            await hx.server_t.publish("work/precache", f"{h2},{EASY:016x}")
+            await wait_until(lambda: h2 in hx.backend.futures)
+            assert h1 not in hx.backend.futures  # not subscribed to ondemand
+
+    run(main())
+
+
+def test_client_stats_and_malformed_messages():
+    async def main():
+        async with ClientHarness() as hx:
+            await hx.client.setup()
+            hx.client.start_loops()
+            await hx.server_t.publish("work/ondemand", "not-a-valid-payload")
+            await hx.server_t.publish(
+                f"client/{PAYOUT}",
+                json.dumps({"precache": 5, "ondemand": 2, "block_rewarded": "AB" * 32}),
+                qos=QOS_1,
+            )
+            await wait_until(lambda: hx.client.stats["works_accepted"] == 1)
+            assert hx.client.stats["latest_stats"]["precache"] == 5
+            # malformed work payload did not kill the loop
+            h = random_hash()
+            await hx.server_t.publish("work/ondemand", f"{h},{EASY:016x}")
+            await wait_until(lambda: h in hx.backend.futures)
+
+    run(main())

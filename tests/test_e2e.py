@@ -1,0 +1,220 @@
+"""Full-stack end-to-end: HTTP service → server → broker → clients → JAX
+backend → result → winner election → HTTP response. SURVEY.md §7's
+"minimum end-to-end slice", plus the TCP-transport variant.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from tpu_dpow.backend.jax_backend import JaxWorkBackend
+from tpu_dpow.client import ClientConfig, DpowClient
+from tpu_dpow.models import WorkType
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.server.api import ServerRunner
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import default_users
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.transport.tcp import TcpBrokerServer, TcpTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(31)
+EASY_BASE = 0xFF00000000000000  # ~256 hashes expected: instant on CPU jax
+PAYOUT_1 = nc.encode_account(bytes(range(32)))
+PAYOUT_2 = nc.encode_account(bytes(range(1, 33)))
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def make_client(transport, payout):
+    config = ClientConfig(payout_address=payout, startup_heartbeat_wait=3.0)
+    backend = JaxWorkBackend(kernel="xla", sublanes=8, iters=8)
+    return DpowClient(config, transport, backend=backend)
+
+
+async def start_stack(broker, n_clients=2, **server_overrides):
+    config = ServerConfig(
+        base_difficulty=EASY_BASE,
+        throttle=1000.0,
+        heartbeat_interval=0.05,
+        statistics_interval=3600.0,
+        service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+        **server_overrides,
+    )
+    store = MemoryStore()
+    server = DpowServer(config, store, InProcTransport(broker, client_id="server"))
+    runner = ServerRunner(server, config)
+    await runner.start()
+    await store.hset(
+        "service:svc",
+        {"api_key": hash_key("secret"), "public": "N", "display": "svc",
+         "website": "", "precache": "0", "ondemand": "0"},
+    )
+    await store.sadd("services", "svc")
+
+    clients = []
+    payouts = [PAYOUT_1, PAYOUT_2]
+    for i in range(n_clients):
+        c = make_client(
+            InProcTransport(broker, client_id=f"worker{i}", clean_session=False),
+            payouts[i % 2],
+        )
+        await c.setup()
+        c.start_loops()
+        clients.append(c)
+    return runner, server, store, clients
+
+
+async def stop_stack(runner, clients):
+    for c in clients:
+        await c.close()
+    await runner.stop()
+
+
+def test_e2e_http_service_request():
+    async def main():
+        broker = Broker()
+        runner, server, store, clients = await start_stack(broker)
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                h = random_hash()
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h,
+                               "account": PAYOUT_1, "id": 7}
+                ) as resp:
+                    body = await resp.json()
+                assert body.get("id") == 7, body
+                assert "work" in body, body
+                nc.validate_work(h, body["work"], EASY_BASE)
+                # exactly one client was credited (winner election held)
+                await asyncio.sleep(0.1)
+                credits = 0
+                for payout in (PAYOUT_1, PAYOUT_2):
+                    got = await store.hget(f"client:{payout}", "ondemand")
+                    credits += int(got or 0)
+                assert credits == 1
+                # losers were told to cancel; no client still grinds
+                for c in clients:
+                    assert not c.work_handler.ongoing
+        finally:
+            await stop_stack(runner, clients)
+
+    run(main())
+
+
+def test_e2e_burst_of_requests():
+    async def main():
+        broker = Broker()
+        runner, server, store, clients = await start_stack(broker)
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                hashes = [random_hash() for _ in range(8)]
+
+                async def one(h):
+                    async with http.post(
+                        url, json={"user": "svc", "api_key": "secret", "hash": h,
+                                   "timeout": 20}
+                    ) as resp:
+                        return await resp.json()
+
+                bodies = await asyncio.gather(*(one(h) for h in hashes))
+                for h, body in zip(hashes, bodies):
+                    assert "work" in body, body
+                    nc.validate_work(h, body["work"], EASY_BASE)
+        finally:
+            await stop_stack(runner, clients)
+
+    run(main())
+
+
+def test_e2e_precache_then_instant_hit():
+    async def main():
+        broker = Broker()
+        runner, server, store, clients = await start_stack(broker, debug=True)
+        try:
+            h = random_hash()
+            await server.block_arrival_handler(h, PAYOUT_1, None)
+            # workers precache it
+            for _ in range(300):
+                work = await store.get(f"block:{h}")
+                if work and work != "0":
+                    break
+                await asyncio.sleep(0.02)
+            nc.validate_work(h, work, EASY_BASE)
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h}
+                ) as resp:
+                    body = await resp.json()
+            assert body["work"] == work
+            assert await store.hget("service:svc", "precache") == "1"
+        finally:
+            await stop_stack(runner, clients)
+
+    run(main())
+
+
+def test_e2e_over_tcp_transport():
+    """Same flow with the server and a worker on real TCP sockets + ACLs."""
+
+    async def main():
+        broker = Broker(users=default_users())
+        tcp_server = TcpBrokerServer(broker, port=0)
+        await tcp_server.start()
+        port = tcp_server.port
+
+        config = ServerConfig(
+            base_difficulty=EASY_BASE, throttle=1000.0,
+            heartbeat_interval=0.05, statistics_interval=3600.0,
+            service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store,
+            TcpTransport(port=port, username="dpowserver", password="dpowserver",
+                         client_id="server"),
+        )
+        runner = ServerRunner(server, config)
+        await runner.start()
+        await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                         "public": "N", "precache": "0",
+                                         "ondemand": "0"})
+        await store.sadd("services", "svc")
+
+        client = make_client(
+            TcpTransport(port=port, username="client", password="client",
+                         client_id="w-tcp", clean_session=False),
+            PAYOUT_1,
+        )
+        await client.setup()
+        client.start_loops()
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                h = random_hash()
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h,
+                               "timeout": 20}
+                ) as resp:
+                    body = await resp.json()
+            assert "work" in body, body
+            nc.validate_work(h, body["work"], EASY_BASE)
+        finally:
+            await client.close()
+            await runner.stop()
+            await tcp_server.stop()
+
+    run(main())

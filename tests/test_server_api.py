@@ -1,0 +1,125 @@
+"""HTTP/WS service API over real sockets (aiohttp), per reference contract."""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from tpu_dpow.server.api import ServerRunner
+from tests.test_server import ACCOUNT, EASY_BASE, Harness, random_hash
+from tpu_dpow.utils import nanocrypto as nc
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+class ApiHarness(Harness):
+    def __init__(self, **kw):
+        super().__init__(
+            service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0, **kw
+        )
+
+    async def __aenter__(self):
+        self.runner = ServerRunner(self.server, self.config)
+        await self.runner.start()
+        await self.register_service("svc", "secret")
+        self.http = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc):
+        if self.worker_task:
+            self.worker_task.cancel()
+        await self.http.close()
+        await self.runner.stop()
+
+    def url(self, app: str, path: str) -> str:
+        return f"http://127.0.0.1:{self.runner.ports[app]}{path}"
+
+
+def test_post_service_end_to_end():
+    async def main():
+        async with ApiHarness() as hx:
+            await hx.start_worker()
+            h = random_hash()
+            async with hx.http.post(
+                hx.url("service", "/service/"),
+                json={"user": "svc", "api_key": "secret", "hash": h, "id": 42},
+            ) as resp:
+                body = await resp.json()
+            assert body["id"] == 42
+            assert body["hash"] == h
+            nc.validate_work(h, body["work"], EASY_BASE)
+
+    run(main())
+
+
+def test_post_service_bad_json_and_errors():
+    async def main():
+        async with ApiHarness() as hx:
+            # The reference's documented install smoke test:
+            # curl -d "test" → {"error": "Bad request (not json)"}
+            async with hx.http.post(hx.url("service", "/service/"), data=b"test") as r:
+                assert (await r.json())["error"] == "Bad request (not json)"
+            async with hx.http.post(
+                hx.url("service", "/service/"),
+                json={"user": "svc", "api_key": "bad", "hash": random_hash()},
+            ) as r:
+                assert (await r.json())["error"] == "Invalid credentials"
+            # timeout error carries the "timeout" flag for easy checking
+            async with hx.http.post(
+                hx.url("service", "/service/"),
+                json={"user": "svc", "api_key": "secret", "hash": random_hash(),
+                      "timeout": 1},
+            ) as r:
+                body = await r.json()
+            assert body["timeout"] is True and "error" in body
+
+    run(main())
+
+
+def test_websocket_service_api():
+    async def main():
+        async with ApiHarness() as hx:
+            await hx.start_worker()
+            async with hx.http.ws_connect(hx.url("service_ws", "/service_ws/")) as ws:
+                for i in range(3):
+                    h = random_hash()
+                    await ws.send_json(
+                        {"user": "svc", "api_key": "secret", "hash": h, "id": i}
+                    )
+                    body = json.loads((await ws.receive()).data)
+                    assert body["id"] == i
+                    nc.validate_work(h, body["work"], EASY_BASE)
+                await ws.send_str("not json")
+                body = json.loads((await ws.receive()).data)
+                assert body["error"] == "Bad request (not json)"
+
+    run(main())
+
+
+def test_upcheck_and_block_callback():
+    async def main():
+        async with ApiHarness(debug=True) as hx:
+            await hx.start_worker()
+            async with hx.http.get(hx.url("upcheck", "/upcheck/")) as r:
+                assert await r.text() == "up"
+            async with hx.http.get(hx.url("upcheck", "/upcheck/blocks/")) as r:
+                assert await r.text() == ""  # no blocks seen yet
+            # node HTTP callback ingestion (block JSON nested as string,
+            # exactly like the reference node's callback format)
+            h = random_hash()
+            async with hx.http.post(
+                hx.url("blocks", "/block/"),
+                json={"hash": h, "account": ACCOUNT,
+                      "block": json.dumps({"previous": random_hash()})},
+            ) as r:
+                assert r.status == 200
+            async with hx.http.get(hx.url("upcheck", "/upcheck/blocks/")) as r:
+                assert float(await r.text()) >= 0.0
+            await asyncio.sleep(0.1)  # debug mode → precached
+            assert any(m.topic == "work/precache" for m in hx.worker_log)
+
+    run(main())

@@ -196,6 +196,31 @@ def test_tcp_uri_parsing():
         TcpTransport.from_uri("amqp://nope")
 
 
+def test_tcp_close_then_connect_reopens():
+    # Regression: the worker's crash-recovery loop closes the transport and
+    # calls connect() again; that must reopen, not fail "transport closed".
+    async def main():
+        broker = Broker()
+        server = TcpBrokerServer(broker, port=0)
+        await server.start()
+        t = TcpTransport(port=server.port, client_id="re", clean_session=False)
+        await t.connect()
+        await t.subscribe("work/#")
+        await t.close()
+        assert not t.connected
+        await t.connect()
+        assert t.connected
+        pub = TcpTransport(port=server.port)
+        await pub.connect()
+        await asyncio.sleep(0.05)
+        await pub.publish("work/ondemand", "H,d")
+        msgs = await _collect(t, 1)
+        assert msgs[0].payload == "H,d"
+        await t.close(); await pub.close(); await server.stop()
+
+    run(main())
+
+
 def test_tcp_reconnect_replays_subscriptions():
     async def main():
         broker = Broker()

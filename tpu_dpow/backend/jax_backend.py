@@ -102,6 +102,7 @@ class JaxWorkBackend(WorkBackend):
     # -- WorkBackend interface -------------------------------------------
 
     async def setup(self) -> None:
+        self._closed = False  # setup() after close() reopens the engine
         # Self-test: the engine must find a planted easy solution. Also pays
         # the one-time jit compile cost off the event loop.
         probe = search.pack_params(bytes(32), 1, base=0)

@@ -1,0 +1,41 @@
+"""Worker client entrypoint: ``python -m tpu_dpow.client --payout nano_...``.
+
+Replaces the reference's client launcher (reference client/dpow_client.py
+__main__ + run_windows.bat): connects to the broker, joins the swarm, and
+feeds the TPU (or chosen backend) with the swarm's work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..transport.tcp import TcpTransport
+from ..utils.logging import get_logger
+from .app import DpowClient
+from .config import parse_args
+
+
+async def amain(argv=None) -> None:
+    config = parse_args(argv)
+    get_logger("tpu_dpow.client", file_path=config.log_file)
+    transport = TcpTransport.from_uri(
+        config.server_uri,
+        client_id=f"client-{config.payout_address[-8:]}",
+        clean_session=False,
+    )
+    client = DpowClient(config, transport)
+    try:
+        await client.run()
+    finally:
+        await client.close()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(amain(argv))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""DpowClient: the worker that joins the swarm and feeds the TPU.
+
+Semantic port of reference client/dpow_client.py onto this framework's
+transport + backend seams:
+
+  * subscriptions per work preference: ``work/{type}`` at QoS 0,
+    ``cancel/{type}`` at QoS 1, ``client/{payout}`` at QoS 1, with a
+    persistent session so cancels queue across drops (reference :137-147);
+  * startup gate — refuse to run without a live server heartbeat within
+    2 s (reference :115-123);
+  * heartbeat staleness watchdog — alarm after 10 s of silence, recover
+    silently when the server returns (reference :167-179);
+  * results published to ``result/{type}`` as ``hash,work,payout``
+    (reference send_work_result :38-39);
+  * on transport error: sleep and reconnect (reference :189-197).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import traceback
+from typing import Optional
+
+from ..backend import WorkBackend, get_backend
+from ..models import WorkRequest, WorkType
+from ..transport import Message, QOS_0, QOS_1, Transport
+from ..utils import nanocrypto as nc
+from ..utils.logging import get_logger
+from .config import ClientConfig
+from .work_handler import WorkHandler
+
+logger = get_logger("tpu_dpow.client")
+
+
+class DpowClient:
+    def __init__(
+        self,
+        config: ClientConfig,
+        transport: Transport,
+        backend: Optional[WorkBackend] = None,
+    ):
+        self.config = config
+        self.transport = transport
+        backend = backend or get_backend(
+            config.backend,
+            **({"uri": config.worker_uri} if config.backend == "subprocess" else
+               {"max_batch": config.max_batch}),
+        )
+        self.work_handler = WorkHandler(backend, self._send_result)
+        self.last_heartbeat: Optional[float] = None
+        self._server_online = True
+        self._tasks: list = []
+        self.stats = {"works_accepted": 0, "latest_stats": None}
+
+    # -- wiring ---------------------------------------------------------
+
+    async def _send_result(self, request: WorkRequest, work: str) -> None:
+        await self.transport.publish(
+            f"result/{request.work_type.value}",
+            f"{request.block_hash},{work},{self.config.payout_address}",
+            qos=QOS_0,
+        )
+
+    async def setup(self) -> None:
+        await self.transport.connect()
+        await self.transport.subscribe("heartbeat", qos=QOS_0)
+        # Startup gate: a heartbeat must arrive promptly or the server is
+        # down and there is no point joining (reference :115-123).
+        try:
+            await asyncio.wait_for(
+                self._await_first_heartbeat(), timeout=self.config.startup_heartbeat_wait
+            )
+        except asyncio.TimeoutError:
+            raise ConnectionError(
+                "Server is offline (no heartbeat within "
+                f"{self.config.startup_heartbeat_wait}s)"
+            )
+        for work_type in self.config.work_type.topics:
+            await self.transport.subscribe(f"work/{work_type}", qos=QOS_0)
+            await self.transport.subscribe(f"cancel/{work_type}", qos=QOS_1)
+        if self.config.payout_address:
+            await self.transport.subscribe(
+                f"client/{self.config.payout_address}", qos=QOS_1
+            )
+        await self.work_handler.start()
+
+    async def _await_first_heartbeat(self) -> None:
+        async for msg in self.transport.messages():
+            if msg.topic == "heartbeat":
+                self.last_heartbeat = time.monotonic()
+                return
+
+    # -- message dispatch (reference :97-105) ---------------------------
+
+    async def handle_message(self, msg: Message) -> None:
+        topic = msg.topic
+        if topic == "heartbeat":
+            self.last_heartbeat = time.monotonic()
+        elif topic.startswith("work/"):
+            await self.handle_work(topic.split("/", 1)[1], msg.payload)
+        elif topic.startswith("cancel/"):
+            await self.work_handler.queue_cancel(msg.payload.strip())
+        elif topic.startswith("client/"):
+            self.handle_stats(msg.payload)
+
+    async def handle_work(self, work_type: str, payload: str) -> None:
+        try:
+            block_hash, difficulty_hex = payload.split(",")
+            request = WorkRequest(
+                block_hash=block_hash,
+                difficulty=int(difficulty_hex, 16),
+                work_type=WorkType(work_type),
+            )
+        except (ValueError, nc.InvalidBlockHash, nc.InvalidDifficulty) as e:
+            logger.warning("could not parse work message %r: %s", payload, e)
+            return
+        await self.work_handler.queue_work(request)
+
+    def handle_stats(self, payload: str) -> None:
+        """Server acknowledgment of accepted work (reference :87-95)."""
+        try:
+            stats = json.loads(payload)
+        except json.JSONDecodeError:
+            return
+        if "error" in stats:
+            logger.error("server reported: %s", stats["error"])
+            return
+        self.stats["works_accepted"] += 1
+        self.stats["latest_stats"] = stats
+        logger.info(
+            "work accepted (total precache=%s ondemand=%s, rewarded for %s)",
+            stats.get("precache"), stats.get("ondemand"), stats.get("block_rewarded"),
+        )
+
+    # -- loops ----------------------------------------------------------
+
+    async def _message_loop(self) -> None:
+        async for msg in self.transport.messages():
+            try:
+                await self.handle_message(msg)
+            except Exception:
+                logger.error("message handling failed:\n%s", traceback.format_exc())
+
+    async def _heartbeat_check_loop(self) -> None:
+        """Staleness watchdog (reference :167-179)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self.last_heartbeat is None:
+                continue
+            silence = time.monotonic() - self.last_heartbeat
+            if silence > self.config.heartbeat_timeout and self._server_online:
+                self._server_online = False
+                logger.warning(
+                    "server heartbeat lost (%.0fs); connection may be dead", silence
+                )
+            elif silence <= self.config.heartbeat_timeout and not self._server_online:
+                self._server_online = True
+                logger.info("server heartbeat recovered")
+
+    def start_loops(self) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._message_loop()),
+            asyncio.ensure_future(self._heartbeat_check_loop()),
+        ]
+
+    async def run(self) -> None:
+        """Full lifecycle incl. error→sleep→reconnect (reference :156-197)."""
+        while True:
+            try:
+                await self.setup()
+                self.start_loops()
+                await asyncio.gather(*self._tasks)
+            except asyncio.CancelledError:
+                raise
+            except ConnectionError:
+                raise  # startup gate: fail fast, do not retry-loop
+            except Exception:
+                logger.error("client crashed; reconnecting in %.0fs:\n%s",
+                             self.config.reconnect_delay, traceback.format_exc())
+                await self.close()
+                await asyncio.sleep(self.config.reconnect_delay)
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        if self.work_handler._started:
+            await self.work_handler.stop()
+        await self.transport.close()

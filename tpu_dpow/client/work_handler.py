@@ -1,0 +1,162 @@
+"""WorkHandler: queue discipline between the transport and the compute engine.
+
+Semantic port of the reference's dispatch boundary (reference
+client/work_handler.py) minus its one-item-at-a-time HTTP dialogue:
+
+  * dedup on enqueue against both the queue and ongoing work
+    (reference :84-89);
+  * RANDOM pop order — the swarm-decorrelation property the reference gets
+    from random queue popping (reference :29-33): two workers with the same
+    backlog won't grind it in the same order;
+  * ``concurrency`` items in flight at once — the reference is forced to 1
+    by its blocking work-server dialogue; the TPU engine batches in-flight
+    requests into one device launch, so the handler keeps several going;
+  * cancel-vs-completion race: a cancel for an in-queue item just removes
+    it; for an ongoing item it reaches into the backend; a result arriving
+    for a hash no longer in ``ongoing`` is dropped (reference :61-80,
+    109-114);
+  * also fixes the reference's latent NameError in its enqueue error path
+    (reference work_handler.py:95 references an undefined variable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import traceback
+from typing import Awaitable, Callable, Dict, Optional, Set
+
+from ..backend import WorkBackend, WorkCancelled, WorkError
+from ..models import WorkRequest
+from ..utils.logging import get_logger
+
+logger = get_logger("tpu_dpow.client")
+
+ResultCallback = Callable[[WorkRequest, str], Awaitable[None]]
+
+
+class WorkQueue:
+    """Async queue with membership tests and random pop (reference :9-36)."""
+
+    def __init__(self):
+        self._items: list = []
+        self._waiter: asyncio.Event = asyncio.Event()
+
+    def __contains__(self, block_hash: str) -> bool:
+        return any(r.block_hash == block_hash for r in self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def put(self, request: WorkRequest) -> None:
+        self._items.append(request)
+        self._waiter.set()
+
+    def remove(self, block_hash: str) -> bool:
+        for i, r in enumerate(self._items):
+            if r.block_hash == block_hash:
+                del self._items[i]
+                return True
+        return False
+
+    async def pop_random(self) -> WorkRequest:
+        while not self._items:
+            self._waiter.clear()
+            await self._waiter.wait()
+        i = random.randrange(len(self._items))
+        item = self._items[i]
+        del self._items[i]
+        return item
+
+
+class WorkHandler:
+    def __init__(
+        self,
+        backend: WorkBackend,
+        result_callback: ResultCallback,
+        *,
+        concurrency: int = 8,
+    ):
+        self.backend = backend
+        self.result_callback = result_callback
+        self.concurrency = concurrency
+        self.queue = WorkQueue()
+        self.ongoing: Dict[str, WorkRequest] = {}
+        self._workers: list = []
+        self._started = False
+        self.stats = {"queued": 0, "deduped": 0, "solved": 0, "cancelled": 0, "errors": 0}
+
+    async def start(self) -> None:
+        # Startup probe: a broken engine must fail loudly before the client
+        # joins the swarm (reference :50-55's invalid-action probe analog).
+        await self.backend.setup()
+        self._workers = [
+            asyncio.ensure_future(self._worker_loop()) for _ in range(self.concurrency)
+        ]
+        self._started = True
+
+    async def stop(self) -> None:
+        self._started = False
+        for w in self._workers:
+            w.cancel()
+        await asyncio.gather(*self._workers, return_exceptions=True)
+        self._workers = []
+        await self.backend.close()
+
+    async def queue_work(self, request: WorkRequest) -> None:
+        """Enqueue unless already queued or ongoing (reference :83-94)."""
+        bh = request.block_hash
+        if bh in self.queue or bh in self.ongoing:
+            self.stats["deduped"] += 1
+            return
+        self.queue.put(request)
+        self.stats["queued"] += 1
+
+    async def queue_cancel(self, block_hash: str) -> None:
+        """Cancel queued or ongoing work for a hash (reference :61-80)."""
+        if self.queue.remove(block_hash):
+            logger.debug("removed queued work %s", block_hash)
+            self.stats["cancelled"] += 1
+            return
+        if block_hash in self.ongoing:
+            # Drop from ongoing FIRST: if the backend solves it in the same
+            # instant, the completion sees it missing and discards
+            # (reference :71-74, 109-114).
+            self.ongoing.pop(block_hash, None)
+            self.stats["cancelled"] += 1
+            try:
+                await self.backend.cancel(block_hash)
+            except Exception as e:
+                logger.warning("backend cancel failed for %s: %s", block_hash, e)
+
+    async def _worker_loop(self) -> None:
+        while True:
+            request = await self.queue.pop_random()
+            bh = request.block_hash
+            self.ongoing[bh] = request
+            try:
+                work = await self.backend.generate(request)
+            except WorkCancelled:
+                self.ongoing.pop(bh, None)
+                continue
+            except WorkError as e:
+                self.ongoing.pop(bh, None)
+                self.stats["errors"] += 1
+                logger.error("work generation failed for %s: %s", bh, e)
+                continue
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.ongoing.pop(bh, None)
+                self.stats["errors"] += 1
+                logger.error("unexpected backend failure:\n%s", traceback.format_exc())
+                continue
+            # Completion/cancel race: only report if still ongoing.
+            if self.ongoing.pop(bh, None) is None:
+                logger.debug("work %s completed after cancel; dropped", bh)
+                continue
+            self.stats["solved"] += 1
+            try:
+                await self.result_callback(request, work)
+            except Exception:
+                logger.error("result callback failed:\n%s", traceback.format_exc())

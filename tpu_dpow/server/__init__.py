@@ -1,0 +1,3 @@
+from .app import DpowServer, hash_key, WORK_PENDING  # noqa: F401
+from .config import ServerConfig, parse_args  # noqa: F401
+from .exceptions import InvalidRequest, RequestTimeout, RetryRequest  # noqa: F401

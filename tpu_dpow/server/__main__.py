@@ -1,0 +1,74 @@
+"""Server entrypoint: ``python -m tpu_dpow.server [flags]``.
+
+Composition root: config → store → transport (TCP to an external broker, or
+an in-process broker when --inproc_broker is set) → DpowServer → aiohttp
+apps → node feed. Mirrors reference dpow_server.py:445-515 main().
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..store import get_store
+from ..transport import default_users
+from ..transport.broker import Broker
+from ..transport.inproc import InProcTransport
+from ..transport.tcp import TcpBrokerServer, TcpTransport
+from ..utils.logging import get_logger
+from .api import ServerRunner
+from .app import DpowServer
+from .config import parse_args
+from .nano_ws import NanoWebsocketClient
+
+
+async def amain(argv=None) -> None:
+    config = parse_args(argv)
+    logger = get_logger("tpu_dpow.server", file_path=config.log_file, debug=config.debug)
+
+    broker_server = None
+    if config.inproc_broker:
+        broker = Broker(users=default_users())
+        from urllib.parse import urlparse
+
+        u = urlparse(config.transport_uri)
+        broker_server = TcpBrokerServer(broker, host=u.hostname or "127.0.0.1",
+                                        port=u.port or 1883)
+        await broker_server.start()
+        transport = InProcTransport(
+            broker, username="dpowserver", password="dpowserver", client_id="server"
+        )
+    else:
+        transport = TcpTransport.from_uri(config.transport_uri, client_id="server")
+
+    store = get_store(config.store_uri)
+    server = DpowServer(config, store, transport)
+    runner = ServerRunner(server, config)
+    await runner.start()
+    logger.info("tpu-dpow server up; service ports %s", runner.ports)
+
+    node_client = None
+    if config.enable_precache and config.node_ws_uri:
+        node_client = NanoWebsocketClient(config.node_ws_uri, server.block_arrival_ws_handler)
+        node_client.start()
+
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        if node_client:
+            await node_client.stop()
+        await runner.stop()
+        if broker_server:
+            await broker_server.stop()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(amain(argv))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""HTTP / websocket faces of the server (reference dpow_server.py:378-500).
+
+Four aiohttp apps, same port layout as the reference:
+  * service API     — POST /service/  (port 5030 or a unix socket for nginx)
+  * service WS API  — GET /service_ws/ (port 5035, heartbeat 20 s, 2 KB msgs)
+  * upchecks        — GET /upcheck/, /upcheck/blocks/ (port 5031)
+  * block callback  — POST /block/ (port 5040; node HTTP callback ingestion,
+                      the precache feed without a node websocket)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import traceback
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+from ..utils.logging import get_logger
+from .app import DpowServer
+from .config import ServerConfig
+from .exceptions import InvalidRequest, RequestTimeout, RetryRequest
+
+logger = get_logger("tpu_dpow.server")
+
+
+async def _handle_service_request(server: DpowServer, data) -> dict:
+    request_id = None
+    try:
+        if not isinstance(data, dict):
+            raise InvalidRequest("Bad request (not json)")
+        request_id = data.get("id")
+        response = await server.service_handler(data)
+    except InvalidRequest as e:
+        response = {"error": e.reason}
+    except RequestTimeout:
+        response = {"error": "Timeout reached without work", "timeout": True}
+    except RetryRequest:
+        response = {"error": "Retry request"}
+    except Exception:
+        response = {
+            "error": "Unknown error, please report the following timestamp "
+            f"to the maintainers: {datetime.datetime.now()}"
+        }
+        logger.critical(traceback.format_exc())
+    if request_id is not None:
+        response["id"] = request_id
+    return response
+
+
+def build_apps(server: DpowServer):
+    """Returns (service_app, ws_app, upcheck_app, blocks_app)."""
+
+    async def service_post_handler(request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+        except (ValueError, json.JSONDecodeError):
+            return web.json_response({"error": "Bad request (not json)"})
+        return web.json_response(await _handle_service_request(server, data))
+
+    async def service_ws_handler(request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=2048)
+        await ws.prepare(request)
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    try:
+                        data = json.loads(msg.data)
+                    except json.JSONDecodeError:
+                        await ws.send_json({"error": "Bad request (not json)"})
+                        continue
+                    await ws.send_json(await _handle_service_request(server, data))
+        except Exception:
+            pass
+        return ws
+
+    async def upcheck_handler(request: web.Request) -> web.Response:
+        return web.Response(text="up")
+
+    async def upcheck_blocks_handler(request: web.Request) -> web.Response:
+        if not server.last_block:
+            return web.Response(text="")
+        import time
+
+        return web.Response(text=f"{time.time() - server.last_block:.2f}")
+
+    async def block_cb_handler(request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+            await server.block_arrival_ws_handler(data)
+        except Exception:
+            logger.error("unable to process block callback:\n%s", traceback.format_exc())
+        return web.Response()
+
+    service_app = web.Application()
+    service_app.router.add_post("/service/", service_post_handler)
+    service_app.router.add_post("/service", service_post_handler)
+
+    ws_app = web.Application()
+    ws_app.router.add_get("/service_ws/", service_ws_handler)
+    ws_app.router.add_get("/service_ws", service_ws_handler)
+
+    upcheck_app = web.Application()
+    upcheck_app.router.add_get("/upcheck/", upcheck_handler)
+    upcheck_app.router.add_get("/upcheck", upcheck_handler)
+    upcheck_app.router.add_get("/upcheck/blocks/", upcheck_blocks_handler)
+    upcheck_app.router.add_get("/upcheck/blocks", upcheck_blocks_handler)
+
+    blocks_app = web.Application()
+    blocks_app.router.add_post("/block/", block_cb_handler)
+    blocks_app.router.add_post("/block", block_cb_handler)
+
+    return service_app, ws_app, upcheck_app, blocks_app
+
+
+class ServerRunner:
+    """Owns the aiohttp runners + the orchestrator's background loops."""
+
+    def __init__(self, server: DpowServer, config: Optional[ServerConfig] = None):
+        self.server = server
+        self.config = config or server.config
+        self._runners: list = []
+        self.ports: dict = {}
+
+    async def start(self) -> None:
+        await self.server.setup()
+        self.server.start_loops()
+        service_app, ws_app, upcheck_app, blocks_app = build_apps(self.server)
+        c = self.config
+        specs = [
+            ("service", service_app, c.service_port, c.web_path),
+            ("service_ws", ws_app, c.service_ws_port, None),
+            ("upcheck", upcheck_app, c.upcheck_port, None),
+        ]
+        if c.enable_precache and not c.node_ws_uri:
+            specs.append(("blocks", blocks_app, c.block_cb_port, None))
+        for name, app, port, unix_path in specs:
+            runner = web.AppRunner(app)
+            await runner.setup()
+            if unix_path:
+                site = web.UnixSite(runner, unix_path)
+            else:
+                site = web.TCPSite(runner, c.host, port)
+            await site.start()
+            if not unix_path:
+                self.ports[name] = site._server.sockets[0].getsockname()[1]
+            self._runners.append(runner)
+
+    async def stop(self) -> None:
+        for runner in self._runners:
+            await runner.cleanup()
+        self._runners = []
+        await self.server.close()

@@ -1,0 +1,469 @@
+"""DpowServer: the request-orchestration core.
+
+Semantic port of the reference's central state machine (reference
+server/dpow_server.py:31-376) onto this framework's injectable seams
+(Store, Transport) — with the reference's known regressions fixed:
+
+  * difficulty multipliers WORK (the reference ships
+    FORCE_ONLY_BASE_DIFFICULTY=True, neutering them — reference
+    dpow_server.py:39-40);
+  * injectable transport + store make every path testable in-process
+    (the reference has no test seams at all, SURVEY.md §4);
+  * optional MemoryStore checkpointing to disk (the reference's durability
+    story is "it's all in Redis", SURVEY.md §5.4).
+
+Responsibilities and their reference anchors:
+  service_handler        — auth, throttle, validate, precache-hit,
+                           dispatch + future wait      (dpow_server.py:229-376)
+  client_result_handler  — winner election, cancel fan-out, rewards
+                                                       (dpow_server.py:95-168)
+  block_arrival_handler  — precache pipeline           (dpow_server.py:170-227)
+  heartbeat/statistics   — liveness + public stats     (mqtt.py:76-89, :82-93)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import time
+import traceback
+from typing import Dict, Optional
+
+from ..models import DifficultyModel, WorkType
+from ..store import MemoryStore, Store
+from ..transport import Message, QOS_0, QOS_1, Transport
+from ..utils import nanocrypto as nc
+from ..utils.logging import get_logger
+from ..utils.throttle import Throttler
+from .config import ServerConfig
+from .exceptions import InvalidRequest, RequestTimeout, RetryRequest
+
+logger = get_logger("tpu_dpow.server")
+
+WORK_PENDING = "0"
+
+
+def hash_key(api_key: str) -> str:
+    """Service api_key hashing (parity: reference scripts/services.py:27-30)."""
+    m = hashlib.blake2b()
+    m.update(api_key.encode())
+    return m.hexdigest()
+
+
+class DpowServer:
+    def __init__(
+        self,
+        config: ServerConfig,
+        store: Store,
+        transport: Transport,
+    ):
+        self.config = config
+        self.store = store
+        self.transport = transport
+        self.difficulty_model = DifficultyModel(
+            base_difficulty=config.base_difficulty,
+            max_multiplier=config.max_multiplier,
+        )
+        self.work_futures: Dict[str, asyncio.Future] = {}
+        self._future_waiters: Dict[str, int] = {}
+        self.service_throttlers: Dict[str, Throttler] = {}
+        self.last_block: Optional[float] = None
+        self._tasks: list = []
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def setup(self) -> None:
+        await self.store.setup()
+        if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
+            try:
+                self.store.load(self.config.checkpoint_path)
+                logger.info("restored state checkpoint from %s", self.config.checkpoint_path)
+            except FileNotFoundError:
+                pass
+        await self.transport.connect()
+        # Server consumes results; everything else it publishes.
+        await self.transport.subscribe("result/#", qos=QOS_0)
+        self._started = True
+
+    def start_loops(self) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._message_loop()),
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._statistics_loop()),
+        ]
+        if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
+            self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
+
+    async def close(self) -> None:
+        self._started = False
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
+            self.store.save(self.config.checkpoint_path)
+        await self.transport.close()
+        await self.store.close()
+
+    # ------------------------------------------------------------------
+    # background loops
+    # ------------------------------------------------------------------
+
+    async def _message_loop(self) -> None:
+        """Dispatch inbound transport messages (reference mqtt.py:54-74)."""
+        async for msg in self.transport.messages():
+            try:
+                if msg.topic.startswith("result/"):
+                    await self.client_result_handler(msg.topic, msg.payload)
+            except Exception:
+                logger.error("result handling failed:\n%s", traceback.format_exc())
+
+    async def _heartbeat_loop(self) -> None:
+        """~1 Hz empty heartbeat (reference mqtt.py:76-89)."""
+        while True:
+            try:
+                await self.transport.publish("heartbeat", "", qos=QOS_0)
+            except Exception as e:
+                logger.warning("heartbeat publish failed: %s", e)
+            await asyncio.sleep(self.config.heartbeat_interval)
+
+    async def _statistics_loop(self) -> None:
+        """5-minute public statistics broadcast (reference dpow_server.py:82-93)."""
+        while True:
+            await asyncio.sleep(self.config.statistics_interval)
+            try:
+                stats = await self.all_statistics()
+                await self.transport.publish("statistics", json.dumps(stats), qos=QOS_0)
+            except Exception as e:
+                logger.warning("statistics publish failed: %s", e)
+
+    async def _checkpoint_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.checkpoint_interval)
+            try:
+                self.store.save(self.config.checkpoint_path)
+            except Exception as e:
+                logger.warning("checkpoint failed: %s", e)
+
+    # ------------------------------------------------------------------
+    # statistics (reference redis_db.py:25-52 aggregation)
+    # ------------------------------------------------------------------
+
+    async def all_statistics(self) -> dict:
+        precache_total = int(await self.store.get("stats:precache") or 0)
+        ondemand_total = int(await self.store.get("stats:ondemand") or 0)
+        public_services = []
+        private_services = {"count": 0, "precache": 0, "ondemand": 0}
+        for service in await self.store.smembers("services"):
+            info = await self.store.hgetall(f"service:{service}")
+            entry = {
+                "display": info.get("display", ""),
+                "website": info.get("website", ""),
+                "precache": int(info.get("precache", 0)),
+                "ondemand": int(info.get("ondemand", 0)),
+            }
+            if info.get("public") == "Y":
+                public_services.append(entry)
+            else:
+                private_services["count"] += 1
+                private_services["precache"] += entry["precache"]
+                private_services["ondemand"] += entry["ondemand"]
+        return {
+            "services": {"public": public_services, "private": private_services},
+            "work": {"precache": precache_total, "ondemand": ondemand_total},
+        }
+
+    # ------------------------------------------------------------------
+    # result path (reference dpow_server.py:95-168)
+    # ------------------------------------------------------------------
+
+    async def client_update(self, account: str, work_type: str, block_rewarded: str) -> None:
+        await self.store.hincrby(f"client:{account}", work_type, 1)
+        stats = await self.store.hgetall(f"client:{account}")
+        payload = {k: int(v) for k, v in stats.items()}
+        payload["block_rewarded"] = block_rewarded
+        await self.transport.publish(f"client/{account}", json.dumps(payload), qos=QOS_1)
+
+    async def client_result_handler(self, topic: str, content: str) -> None:
+        try:
+            block_hash, work, client = content.split(",")
+        except ValueError:
+            return
+
+        # Work still wanted? (hash deleted once its frontier moved on)
+        available = await self.store.get(f"block:{block_hash}")
+        if not available or available != WORK_PENDING:
+            return
+
+        work_type = await self.store.get(f"work-type:{block_hash}") or WorkType.PRECACHE.value
+
+        difficulty_hex = await self.store.get(f"block-difficulty:{block_hash}")
+        difficulty = int(difficulty_hex, 16) if difficulty_hex else self.config.base_difficulty
+        try:
+            nc.validate_work(block_hash, work, difficulty)
+        except (nc.InvalidWork, nc.InvalidBlockHash):
+            return
+
+        # Winner election: exactly one result claims the lock
+        # (reference dpow_server.py:138).
+        if not await self.store.setnx(
+            f"block-lock:{block_hash}", "1", expire=self.config.winner_lock_expiry
+        ):
+            return
+
+        await self.store.set(f"block:{block_hash}", work, expire=self.config.block_expiry)
+
+        future = self.work_futures.get(block_hash)
+        if future is not None and not future.done():
+            future.set_result(work)
+
+        # Tell everyone else to stop burning lanes on this hash.
+        await self.transport.publish(f"cancel/{work_type}", block_hash, qos=QOS_1)
+
+        try:
+            nc.validate_account(client)
+        except nc.InvalidAccount:
+            await self.transport.publish(
+                f"client/{client}",
+                json.dumps({"error": f"Work accepted but account {client} is invalid"}),
+                qos=QOS_1,
+            )
+            return
+
+        await asyncio.gather(
+            self.client_update(client, work_type, block_hash),
+            self.store.incrby(f"stats:{work_type}"),
+            self.store.sadd("clients", client),
+        )
+
+    # ------------------------------------------------------------------
+    # precache pipeline (reference dpow_server.py:170-227)
+    # ------------------------------------------------------------------
+
+    async def block_arrival_handler(
+        self, block_hash: str, account: str, previous: Optional[str]
+    ) -> None:
+        self.last_block = time.time()
+        should_precache = self.config.debug
+        previous_exists = False
+        old_frontier = await self.store.get(f"account:{account}")
+
+        if old_frontier:
+            if old_frontier == block_hash:
+                return  # duplicate confirmation
+            should_precache = True
+        elif previous is not None:
+            previous_exists = await self.store.exists(f"block:{previous}")
+            if previous_exists:
+                should_precache = True
+
+        if not should_precache or not self.config.enable_precache:
+            return
+
+        aws = [
+            self.store.set(f"account:{account}", block_hash, expire=self.config.account_expiry),
+            self.store.set(f"block:{block_hash}", WORK_PENDING, expire=self.config.block_expiry),
+            self.store.set(
+                f"work-type:{block_hash}", WorkType.PRECACHE.value, expire=self.config.block_expiry
+            ),
+            self.transport.publish(
+                "work/precache",
+                f"{block_hash},{self.config.base_difficulty:016x}",
+                qos=QOS_0,
+            ),
+        ]
+        if old_frontier:
+            aws.append(self.store.delete(f"block:{old_frontier}"))
+        elif previous_exists:
+            aws.append(self.store.delete(f"block:{previous}"))
+        await asyncio.gather(*aws)
+
+    async def block_arrival_ws_handler(self, data: dict) -> None:
+        try:
+            block = data["block"]
+            if isinstance(block, str):
+                block = json.loads(block)
+            await self.block_arrival_handler(
+                data["hash"], data["account"], block.get("previous")
+            )
+        except Exception:
+            logger.error("unable to process block:\n%s", traceback.format_exc())
+
+    # ------------------------------------------------------------------
+    # service path (reference dpow_server.py:229-376)
+    # ------------------------------------------------------------------
+
+    async def _authenticate(self, data: dict) -> str:
+        service, api_key = str(data["user"]), str(data["api_key"])
+        db_key = await self.store.hget(f"service:{service}", "api_key")
+        if db_key is None or hash_key(api_key) != db_key:
+            raise InvalidRequest("Invalid credentials")
+        return service
+
+    def _resolve_difficulty(self, data: dict) -> int:
+        multiplier = data.get("multiplier")
+        difficulty_hex = data.get("difficulty")
+        try:
+            if multiplier is not None:
+                # multiplier overrides difficulty (reference service/README.md)
+                return self.difficulty_model.resolve(multiplier=float(multiplier))
+            if difficulty_hex is not None:
+                return self.difficulty_model.resolve(difficulty_hex=str(difficulty_hex))
+        except nc.InvalidMultiplier:
+            raise InvalidRequest(
+                f"Difficulty outside allowed range. Max multiplier: "
+                f"{self.config.max_multiplier}"
+            )
+        except nc.InvalidDifficulty:
+            raise InvalidRequest("Invalid difficulty")
+        except (ValueError, TypeError):
+            raise InvalidRequest("Invalid difficulty or multiplier")
+        return self.config.base_difficulty
+
+    def _resolve_timeout(self, data: dict) -> float:
+        timeout = data.get("timeout", self.config.default_timeout)
+        try:
+            timeout = int(timeout)
+            if not (1 <= timeout <= self.config.max_timeout):
+                raise ValueError
+        except (ValueError, TypeError):
+            raise InvalidRequest(
+                f"Timeout must be an integer between 1 and {int(self.config.max_timeout)}"
+            )
+        return float(timeout)
+
+    async def service_handler(self, data: dict) -> dict:
+        if not {"hash", "user", "api_key"} <= data.keys():
+            raise InvalidRequest(
+                "Incorrect submission. Required information: user, api_key, hash"
+            )
+        service = await self._authenticate(data)
+        throttler = self.service_throttlers.get(service)
+        if throttler is None:
+            throttler = self.service_throttlers[service] = Throttler(
+                rate_limit=max(self.config.throttle, 0.1)
+            )
+        async with throttler:
+            try:
+                block_hash = nc.validate_block_hash(str(data["hash"]))
+            except nc.InvalidBlockHash:
+                raise InvalidRequest("Invalid hash")
+            account = data.get("account")
+            if account:
+                account = str(account).replace("xrb_", "nano_")
+                try:
+                    nc.validate_account(account)
+                except nc.InvalidAccount:
+                    raise InvalidRequest("Invalid account")
+            difficulty = self._resolve_difficulty(data)
+            timeout = self._resolve_timeout(data)
+
+            work = await self.store.get(f"block:{block_hash}")
+            if work is None:
+                await self.store.set(
+                    f"block:{block_hash}", WORK_PENDING, expire=self.config.block_expiry
+                )
+
+            work_type = WorkType.ONDEMAND.value
+            if work and work != WORK_PENDING:
+                # Precache hit — but only if it is strong enough for the
+                # requested difficulty (reference dpow_server.py:292-304).
+                work_type = WorkType.PRECACHE.value
+                precached_value = nc.work_value(block_hash, work)
+                if self.difficulty_model.precache_usable(precached_value, difficulty):
+                    # Reusing slightly-weak precache means the served
+                    # difficulty IS the precached value (reference :303:
+                    # "difficulty = precached_difficulty") — final
+                    # validation must agree with the reuse policy.
+                    difficulty = min(difficulty, precached_value)
+                else:
+                    work_type = WorkType.ONDEMAND.value
+                    await self.store.set(
+                        f"block:{block_hash}", WORK_PENDING, expire=self.config.block_expiry
+                    )
+                    # The 5 s winner lock from the precache result must not
+                    # outlive the reset, or the fresh on-demand result would
+                    # be discarded and the request would time out.
+                    await self.store.delete(f"block-lock:{block_hash}")
+                    logger.info(
+                        "forcing ondemand for %s: precached value too weak", block_hash
+                    )
+
+            if work_type == WorkType.ONDEMAND.value:
+                work = await self._dispatch_ondemand(
+                    block_hash, account, difficulty, timeout
+                )
+
+            asyncio.ensure_future(self.store.hincrby(f"service:{service}", work_type))
+
+            # Final validation: never hand a service bad work
+            # (reference dpow_server.py:363-368, demoted there to a log line;
+            # here an invalid result is an error reply).
+            try:
+                nc.validate_work(block_hash, work, difficulty)
+            except nc.InvalidWork:
+                logger.critical(
+                    "work %s for %s failed final validation at %016x",
+                    work, block_hash, difficulty,
+                )
+                raise RetryRequest()
+
+            logger.info("request handled for %s -> %s : %s", service, work_type, block_hash)
+            return {"work": work, "hash": block_hash}
+
+    async def _dispatch_ondemand(
+        self,
+        block_hash: str,
+        account: Optional[str],
+        difficulty: int,
+        timeout: float,
+    ) -> str:
+        if block_hash not in self.work_futures:
+            if account:
+                asyncio.ensure_future(
+                    self.store.set(
+                        f"account:{account}", block_hash, expire=self.config.account_expiry
+                    )
+                )
+            await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
+                                 expire=self.config.block_expiry)
+            if difficulty != self.config.base_difficulty:
+                await self.store.set(
+                    f"block-difficulty:{block_hash}",
+                    f"{difficulty:016x}",
+                    expire=self.config.difficulty_expiry,
+                )
+            self.work_futures[block_hash] = asyncio.get_running_loop().create_future()
+            await self.transport.publish(
+                "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
+            )
+        self._future_waiters[block_hash] = self._future_waiters.get(block_hash, 0) + 1
+        try:
+            work = await asyncio.wait_for(
+                asyncio.shield(self.work_futures[block_hash]), timeout=timeout
+            )
+        except asyncio.CancelledError:
+            # Future cancelled under us: the result may still have landed in
+            # the store via client_result_handler (reference :340-345).
+            work = await self.store.get(f"block:{block_hash}")
+            if not work or work == WORK_PENDING:
+                raise RetryRequest()
+        except asyncio.TimeoutError:
+            raise RequestTimeout()
+        finally:
+            # Refcounted teardown: the future dies with its LAST waiter —
+            # one impatient short-timeout request must not abort concurrent
+            # waiters that still have timeout budget.
+            remaining = self._future_waiters.get(block_hash, 1) - 1
+            if remaining <= 0:
+                self._future_waiters.pop(block_hash, None)
+                future = self.work_futures.pop(block_hash, None)
+                if future is not None and not future.done():
+                    future.cancel()
+            else:
+                self._future_waiters[block_hash] = remaining
+        return work

@@ -1,0 +1,73 @@
+"""Nano node websocket client: the precache feed.
+
+Parity with reference server/dpow/nano_websocket.py: subscribe to the
+``confirmation`` topic with ack, forward every confirmed block to the
+callback, reconnect forever on drop (reference :40-49 reconnects every 30 s;
+here with capped exponential backoff).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Awaitable, Callable, Optional
+
+import websockets
+
+from ..utils.logging import get_logger
+
+logger = get_logger("tpu_dpow.server")
+
+
+class NanoWebsocketClient:
+    def __init__(
+        self,
+        uri: str,
+        callback: Callable[[dict], Awaitable[None]],
+        *,
+        reconnect_interval: float = 30.0,
+    ):
+        self.uri = uri
+        self.callback = callback
+        self.reconnect_interval = reconnect_interval
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    async def _subscribe(self, ws) -> None:
+        await ws.send(
+            json.dumps({"action": "subscribe", "topic": "confirmation", "ack": True})
+        )
+        reply = json.loads(await ws.recv())
+        if reply.get("ack") != "subscribe":
+            raise ConnectionError(f"unexpected subscribe ack: {reply}")
+        logger.info("subscribed to node confirmations at %s", self.uri)
+
+    async def _run(self) -> None:
+        delay = 1.0
+        while not self._stopped:
+            try:
+                async with websockets.connect(self.uri) as ws:
+                    await self._subscribe(ws)
+                    delay = 1.0
+                    async for raw in ws:
+                        data = json.loads(raw)
+                        if data.get("topic") == "confirmation":
+                            await self.callback(data["message"])
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                logger.warning(
+                    "node websocket dropped (%s); reconnecting in %.0fs", e, delay
+                )
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, self.reconnect_interval)
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None

@@ -58,11 +58,9 @@ class InProcTransport(Transport):
     async def messages(self) -> AsyncIterator[Message]:
         session = self._require()
         while session.queue is not None:
-            queue = session.queue
-            try:
-                msg = await queue.get()
-            except asyncio.CancelledError:
-                break
+            # CancelledError must propagate: callers wrap this iterator in
+            # wait_for and rely on cancellation actually cancelling.
+            msg = await session.queue.get()
             if msg is None:  # close() sentinel
                 break
             yield msg

@@ -219,6 +219,12 @@ class TcpTransport(Transport):
         return self._connected
 
     async def connect(self) -> None:
+        if self._closed:
+            # close() → connect() is an explicit reopen (the client's outer
+            # crash-recovery loop relies on it): fresh inbox, fresh acks.
+            self._closed = False
+            self._inbox = asyncio.Queue(maxsize=10_000)
+            self._acks = {}
         last_error: Optional[Exception] = None
         delay = 0.05
         for _ in range(max(self.reconnect_retries, 1)):

@@ -1,0 +1,67 @@
+"""Logger factory: stdout + optional rotating file.
+
+Capability parity with the reference's hybrid watched/timed rotating
+handlers (reference server/dpow/logger.py, client/logger.py): daily
+rotation, bounded backups, DEBUG to file / INFO to stdout.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import sys
+from typing import Optional
+
+
+def get_logger(
+    name: str = "tpu_dpow",
+    *,
+    file_path: Optional[str] = None,
+    debug: bool = False,
+    backup_count: int = 30,
+) -> logging.Logger:
+    """Module-level logger accessor; configures defaults on first touch."""
+    logger = logging.getLogger(name)
+    if logger.handlers:
+        if file_path or debug:
+            # An entrypoint passing explicit flags AFTER import-time default
+            # setup (api.py etc. call get_logger at module level) must win.
+            return configure_logger(
+                name, file_path=file_path, debug=debug, backup_count=backup_count
+            )
+        return logger
+    return configure_logger(
+        name, file_path=file_path, debug=debug, backup_count=backup_count
+    )
+
+
+def configure_logger(
+    name: str = "tpu_dpow",
+    *,
+    file_path: Optional[str] = None,
+    debug: bool = False,
+    backup_count: int = 30,
+) -> logging.Logger:
+    """(Re)build the logger's handlers from the given flags."""
+    logger = logging.getLogger(name)
+    for handler in list(logger.handlers):
+        logger.removeHandler(handler)
+        handler.close()
+    logger.setLevel(logging.DEBUG)
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    stream = logging.StreamHandler(sys.stdout)
+    stream.setLevel(logging.DEBUG if debug else logging.INFO)
+    stream.setFormatter(fmt)
+    logger.addHandler(stream)
+
+    if file_path:
+        os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
+        fileh = logging.handlers.TimedRotatingFileHandler(
+            file_path, when="d", interval=1, backupCount=backup_count
+        )
+        fileh.setLevel(logging.DEBUG)
+        fileh.setFormatter(fmt)
+        logger.addHandler(fileh)
+    return logger

@@ -1,0 +1,41 @@
+"""Async rate limiter (per-service request throttle).
+
+Replacement for the reference's ``asyncio_throttle.Throttler`` dependency
+(reference server/dpow_server.py:45, config default 1 req/s at
+server/dpow/config.py:17): an async context manager that DELAYS entry until
+the sliding-window rate allows it, rather than rejecting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+
+
+class Throttler:
+    def __init__(self, rate_limit: float, period: float = 1.0, clock=time.monotonic):
+        if rate_limit <= 0:
+            raise ValueError("rate_limit must be positive")
+        self.rate_limit = rate_limit
+        self.period = period
+        self._clock = clock
+        self._starts: deque = deque()
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.period
+        while self._starts and self._starts[0] <= horizon:
+            self._starts.popleft()
+
+    async def __aenter__(self):
+        while True:
+            now = self._clock()
+            self._prune(now)
+            if len(self._starts) < self.rate_limit * self.period:
+                self._starts.append(now)
+                return self
+            # Sleep until the oldest start slides out of the window.
+            await asyncio.sleep(max(self._starts[0] + self.period - now, 0.001))
+
+    async def __aexit__(self, *exc):
+        return False
