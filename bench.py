@@ -1,0 +1,80 @@
+"""Headline benchmark: Blake2b nonce-search throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "H/s", "vs_baseline": N}
+
+vs_baseline is measured against BASELINE.json's north-star target of
+1e9 Blake2b hashes/sec/chip (the reference itself publishes no numbers —
+SURVEY.md §6). Run with no args on the machine whose jax.devices()[0] is the
+chip under test; off-TPU it falls back to the XLA scanner with a small
+window so the harness still produces a (much slower) number.
+
+Extra diagnostics (geometry sweep, per-config latency runs) live in
+benchmarks/; this file stays minimal because the driver parses its stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_HS = 1e9  # BASELINE.json north_star: >= 1e9 H/s/chip on v5e
+
+
+def measure(reps: int = 8) -> dict:
+    import jax
+
+    from tpu_dpow.ops import pallas_kernel, search
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    # Unreachable difficulty => every launch scans its whole window, giving
+    # a clean hashes/second measurement (the found path exits *early*, so
+    # this is the conservative lower bound on scan rate).
+    params = np.stack(
+        [search.pack_params(bytes(range(32)), (1 << 64) - 1, 7 << 40)]
+    )
+
+    if on_tpu:
+        sublanes, iters = 64, 1024
+        chunk = sublanes * 128 * iters
+
+        def launch(p):
+            return pallas_kernel.pallas_search_chunk_batch(
+                p, sublanes=sublanes, iters=iters
+            )
+
+    else:
+        chunk = 8 * 128 * 16
+
+        def launch(p):
+            return search.search_chunk_batch(p, chunk_size=chunk)
+
+    pj = jax.device_put(params, dev)
+    np.asarray(launch(pj))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = launch(pj)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    hs = reps * chunk / dt
+    return {
+        "metric": "blake2b_hash_throughput_per_chip",
+        "value": round(hs, 1),
+        "unit": "H/s",
+        "vs_baseline": round(hs / TARGET_HS, 4),
+        "platform": dev.platform,
+        "chunk": chunk,
+        "reps": reps,
+        "seconds": round(dt, 4),
+    }
+
+
+if __name__ == "__main__":
+    result = measure()
+    print(json.dumps(result))
+    sys.exit(0)

@@ -16,4 +16,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The environment may pre-register an accelerator backend at interpreter
+# startup (sitecustomize), which wins over the env var — pin the platform
+# through the config API as well so tests never touch the real chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: XLA-on-CPU compiles dominate test wall clock on
+# small hosts; cache compiled executables across pytest invocations.
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

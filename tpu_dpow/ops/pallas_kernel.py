@@ -47,7 +47,7 @@ DEFAULT_ITERS = 256
 _NOT_FOUND_I32 = np.int32(0x7FFFFFFF)
 
 
-def _search_core(get_param, sublanes: int, iters: int) -> jnp.ndarray:
+def _search_core(get_param, sublanes: int, iters: int, unroll: bool) -> jnp.ndarray:
     """Shared kernel body: scan sublanes*128*iters offsets → best offset."""
     tile = sublanes * 128
     if tile * iters >= 1 << 31:
@@ -67,7 +67,7 @@ def _search_core(get_param, sublanes: int, iters: int) -> jnp.ndarray:
             lo = base_lo + offset
             carry = (lo < base_lo).astype(jnp.uint32)
             hi = base_hi + carry
-            ok = blake2b.pow_meets_difficulty((lo, hi), msg, diff)
+            ok = blake2b.pow_meets_difficulty((lo, hi), msg, diff, unroll=unroll)
             return jnp.min(jnp.where(ok, offset.astype(jnp.int32), _NOT_FOUND_I32))
 
         # Early exit: after a hit, every remaining iteration is a no-op.
@@ -77,32 +77,46 @@ def _search_core(get_param, sublanes: int, iters: int) -> jnp.ndarray:
     return jnp.where(best == _NOT_FOUND_I32, SENTINEL, best.astype(jnp.uint32))
 
 
-def _kernel_single(params_ref, out_ref, *, sublanes: int, iters: int):
-    out_ref[0] = _search_core(lambda i: params_ref[i], sublanes, iters)
+def _kernel_single(params_ref, out_ref, *, sublanes: int, iters: int, unroll: bool):
+    out_ref[0] = _search_core(lambda i: params_ref[i], sublanes, iters, unroll)
 
 
-def _kernel_batched(params_ref, out_ref, *, sublanes: int, iters: int):
+def _kernel_batched(params_ref, out_ref, *, sublanes: int, iters: int, unroll: bool):
     # The whole (B, 12) params array and (B, 1) output live unblocked in
     # SMEM (Mosaic rejects sub-8x128 block tiles even there); each
     # sequential grid step indexes its own row by program_id.
     b = pl.program_id(0)
-    out_ref[b, 0] = _search_core(lambda i: params_ref[b, i], sublanes, iters)
+    out_ref[b, 0] = _search_core(lambda i: params_ref[b, i], sublanes, iters, unroll)
 
 
-@functools.partial(jax.jit, static_argnames=("sublanes", "iters", "interpret"))
+def _default_unroll(interpret: bool) -> bool:
+    # Real TPU lowering gets the flat 12-round body (Mosaic pipelines it);
+    # interpreter runs (CPU tests) get the rolled body — XLA-CPU takes
+    # pathologically long compiling the 5k+-op unrolled graph.
+    return not interpret and jax.default_backend() == "tpu"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sublanes", "iters", "interpret", "unroll")
+)
 def pallas_search_chunk(
     params: jnp.ndarray,
     *,
     sublanes: int = DEFAULT_SUBLANES,
     iters: int = DEFAULT_ITERS,
     interpret: bool = False,
+    unroll: bool | None = None,
 ) -> jnp.ndarray:
     """One kernel launch scanning sublanes*128*iters nonces from params' base.
 
     Same contract as ops/search.py::search_chunk: returns the lowest valid
     offset as uint32, or SENTINEL if the window holds no solution.
     """
-    kernel = functools.partial(_kernel_single, sublanes=sublanes, iters=iters)
+    if unroll is None:
+        unroll = _default_unroll(interpret)
+    kernel = functools.partial(
+        _kernel_single, sublanes=sublanes, iters=iters, unroll=unroll
+    )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((1,), jnp.uint32),
@@ -112,13 +126,16 @@ def pallas_search_chunk(
     )(params)[0]
 
 
-@functools.partial(jax.jit, static_argnames=("sublanes", "iters", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("sublanes", "iters", "interpret", "unroll")
+)
 def pallas_search_chunk_batch(
     params_batch: jnp.ndarray,
     *,
     sublanes: int = DEFAULT_SUBLANES,
     iters: int = DEFAULT_ITERS,
     interpret: bool = False,
+    unroll: bool | None = None,
 ) -> jnp.ndarray:
     """Batched launch: uint32[B, 12] → uint32[B], one grid step per request.
 
@@ -127,8 +144,12 @@ def pallas_search_chunk_batch(
     one-item-at-a-time POSTs to the native worker
     (reference client/work_handler.py:98-108) without recompiles.
     """
+    if unroll is None:
+        unroll = _default_unroll(interpret)
     b = params_batch.shape[0]
-    kernel = functools.partial(_kernel_batched, sublanes=sublanes, iters=iters)
+    kernel = functools.partial(
+        _kernel_batched, sublanes=sublanes, iters=iters, unroll=unroll
+    )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, 1), jnp.uint32),

@@ -26,6 +26,7 @@ early exit and cancellation (SURVEY.md §7 hard part #2).
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +55,9 @@ def pack_params(block_hash: bytes, difficulty: int, base: int) -> np.ndarray:
     return out
 
 
-def chunk_offsets_ok(params: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+def chunk_offsets_ok(
+    params: jnp.ndarray, offsets: jnp.ndarray, *, unroll: Optional[bool] = None
+) -> jnp.ndarray:
     """Predicate for nonce = base + offset, any offset array shape."""
     base_lo = params[BASE_LO]
     base_hi = params[BASE_HI]
@@ -63,23 +66,32 @@ def chunk_offsets_ok(params: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
     hi = base_hi + carry
     msg = [params[i] for i in range(8)]
     diff: U64 = (params[DIFF_LO], params[DIFF_HI])
-    return blake2b.pow_meets_difficulty((lo, hi), msg, diff)
+    return blake2b.pow_meets_difficulty((lo, hi), msg, diff, unroll=unroll)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size",))
-def search_chunk(params: jnp.ndarray, *, chunk_size: int) -> jnp.ndarray:
+_default_unroll = blake2b.default_unroll
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size", "unroll"))
+def search_chunk(
+    params: jnp.ndarray, *, chunk_size: int, unroll: Optional[bool] = None
+) -> jnp.ndarray:
     """Scan [base, base + chunk_size) in one fused launch → first valid offset.
 
     chunk_size must be < 2**32 (offsets are uint32); in practice it is a
     multiple of 1024 to fill (8, 128) VPU tiles.
     """
+    if unroll is None:
+        unroll = _default_unroll()
     offsets = jnp.arange(chunk_size, dtype=jnp.uint32)
-    ok = chunk_offsets_ok(params, offsets)
+    ok = chunk_offsets_ok(params, offsets, unroll=unroll)
     return jnp.min(jnp.where(ok, offsets, SENTINEL))
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size",))
-def search_chunk_batch(params_batch: jnp.ndarray, *, chunk_size: int) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnames=("chunk_size", "unroll"))
+def search_chunk_batch(
+    params_batch: jnp.ndarray, *, chunk_size: int, unroll: Optional[bool] = None
+) -> jnp.ndarray:
     """vmapped chunk scan over a batch of requests: uint32[B,12] → uint32[B].
 
     Batching concurrent (hash, difficulty) requests into one launch is the
@@ -88,7 +100,11 @@ def search_chunk_batch(params_batch: jnp.ndarray, *, chunk_size: int) -> jnp.nda
     cancelled requests are masked by giving them an impossible difficulty
     (all-ones) rather than re-tracing a smaller batch.
     """
-    return jax.vmap(lambda p: search_chunk(p, chunk_size=chunk_size))(params_batch)
+    if unroll is None:
+        unroll = _default_unroll()
+    return jax.vmap(
+        lambda p: search_chunk(p, chunk_size=chunk_size, unroll=unroll)
+    )(params_batch)
 
 
 def nonce_from_offset(base: int, offset: int) -> int:

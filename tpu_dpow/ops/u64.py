@@ -44,10 +44,13 @@ def add(a: U64, b: U64) -> U64:
     """Carry-correct 64-bit add: lo wraps mod 2**32, carry feeds hi."""
     alo, ahi = a
     blo, bhi = b
-    lo = alo + blo
-    # uint32 wrap-around: a sum smaller than either operand means a carry.
-    carry = (lo < alo).astype(jnp.uint32)
-    hi = ahi + bhi + carry
+    # Wraparound is the point; silence numpy's scalar-overflow warning on the
+    # host golden path (jnp arrays never warn, so this is host-only).
+    with np.errstate(over="ignore"):
+        lo = alo + blo
+        # uint32 wrap-around: a sum smaller than either operand means a carry.
+        carry = (lo < alo).astype(jnp.uint32)
+        hi = ahi + bhi + carry
     return lo, hi
 
 

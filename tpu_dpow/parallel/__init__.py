@@ -1,0 +1,12 @@
+"""Multi-chip parallelism: device meshes, sharded nonce search, ICI winner
+election. See mesh_search for the design rationale."""
+
+from .mesh_search import (  # noqa: F401
+    BATCH_AXIS,
+    NONCE_AXIS,
+    expected_steps,
+    make_mesh,
+    replicate_params,
+    sharded_search_chunk_batch,
+    sharded_search_run,
+)
