@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Flood probe: N parallel POSTs (reference service/many_requests.sh).
+# Usage: ./many_requests.sh [count] [url] [user] [api_key]
+set -u
+COUNT="${1:-20}"
+URL="${2:-http://127.0.0.1:5030/service/}"
+USER="${3:-test}"
+KEY="${4:-test}"
+
+for _ in $(seq "$COUNT"); do
+  HASH="$(head -c32 /dev/urandom | od -An -tx1 | tr -d ' \n' | tr 'a-f' 'A-F')"
+  curl -s -m 35 -H 'Content-Type: application/json' \
+    -d "{\"user\":\"$USER\",\"api_key\":\"$KEY\",\"hash\":\"$HASH\"}" "$URL" &
+done
+wait
+echo

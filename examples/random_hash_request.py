@@ -1,0 +1,48 @@
+"""Manual E2E probe: one POST /service/ request with a random hash
+(reference service/random_hash_request.py).
+
+Usage:
+    python examples/random_hash_request.py [--url http://127.0.0.1:5030/service/]
+        [--user ...] [--api_key ...] [--precache-test] [--multiplier N]
+
+--precache-test uses the all-zeros hash, matching the reference's commented
+precache-test hook (reference service/random_hash_request.py:19).
+"""
+
+import argparse
+import json
+import secrets
+import time
+
+import requests
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default="http://127.0.0.1:5030/service/")
+    p.add_argument("--user", default="test")
+    p.add_argument("--api_key", default="test")
+    p.add_argument("--multiplier", type=float, default=None)
+    p.add_argument("--difficulty", default=None)
+    p.add_argument("--timeout", type=int, default=None)
+    p.add_argument("--precache-test", action="store_true",
+                   help="request the all-zeros hash instead of a random one")
+    args = p.parse_args()
+
+    block_hash = "0" * 64 if args.precache_test else secrets.token_hex(32).upper()
+    data = {"user": args.user, "api_key": args.api_key, "hash": block_hash}
+    for field in ("multiplier", "difficulty", "timeout"):
+        value = getattr(args, field)
+        if value is not None:
+            data[field] = value
+
+    start = time.perf_counter()
+    reply = requests.post(args.url, json=data, timeout=35)
+    elapsed = (time.perf_counter() - start) * 1000
+    print(json.dumps(reply.json(), indent=2))
+    print(f"round-trip: {elapsed:.1f} ms")
+    return 0 if "work" in reply.json() else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,50 @@
+"""Manual E2E probe: N sequential requests over one persistent websocket
+(reference service/websocket_test.py — the reference motivates WSS over POST
+with the >=200 ms SSL handshake cost, reference service/README.md:21).
+
+Usage:
+    python examples/websocket_test.py [--url ws://127.0.0.1:5035/service_ws/] [-n 5]
+"""
+
+import argparse
+import asyncio
+import json
+import secrets
+import time
+
+import aiohttp
+
+
+async def run(url: str, n: int, user: str, api_key: str) -> int:
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(url) as ws:
+            for i in range(n):
+                request = {
+                    "user": user,
+                    "api_key": api_key,
+                    "hash": secrets.token_hex(32).upper(),
+                    "id": i,
+                }
+                start = time.perf_counter()
+                await ws.send_json(request)
+                reply = json.loads((await ws.receive()).data)
+                elapsed = (time.perf_counter() - start) * 1000
+                ok = "work" in reply
+                print(f"[{i}] {'ok' if ok else reply}  {elapsed:.1f} ms")
+                if not ok:
+                    return 1
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default="ws://127.0.0.1:5035/service_ws/")
+    p.add_argument("-n", type=int, default=5)
+    p.add_argument("--user", default="test")
+    p.add_argument("--api_key", default="test")
+    args = p.parse_args()
+    return asyncio.run(run(args.url, args.n, args.user, args.api_key))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

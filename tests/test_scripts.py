@@ -1,0 +1,217 @@
+"""Operator CLI suite (tpu_dpow/scripts) — reference server/scripts parity.
+
+The reference's scripts are redis-only and untested (SURVEY.md §4); here
+each CLI runs against the same Store seam the server uses, so the whole
+admin surface is exercised in-process.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tpu_dpow.scripts import check_latency as cl
+from tpu_dpow.scripts import client_snapshot as cs
+from tpu_dpow.scripts import open_store, payouts, services
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+# A syntactically valid nano address for payout tests.
+VALID_ACCOUNT = nc.encode_account(bytes(range(32)))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------- services
+
+
+def test_services_add_check_list_delete(capsys):
+    async def flow():
+        store = MemoryStore()
+        args = services.build_parser().parse_args(
+            ["add", "--user", "faucet", "--api_key", "s3cret", "--display",
+             "Faucet", "--website", "https://f.example", "--public"]
+        )
+        assert await services.add(store, args) == 0
+        # api_key stored hashed, never plaintext (reference services.py:27-30)
+        record = await store.hgetall("service:faucet")
+        assert record["api_key"] == services.hash_api_key("s3cret")
+        assert "s3cret" not in json.dumps(record)
+        assert record["public"] == "Y"
+        assert "faucet" in await store.smembers("services")
+
+        # duplicate add refused
+        assert await services.add(store, args) == 1
+
+        args2 = services.build_parser().parse_args(
+            ["update", "--user", "faucet", "--private", "--website", "https://g"]
+        )
+        assert await services.update(store, args2) == 0
+        record = await store.hgetall("service:faucet")
+        assert record["public"] == "N" and record["website"] == "https://g"
+
+        args3 = services.build_parser().parse_args(["check", "--user", "faucet"])
+        assert await services.check(store, args3) == 0
+
+        args4 = services.build_parser().parse_args(["delete", "--user", "faucet"])
+        assert await services.delete(store, args4) == 0
+        assert await store.hgetall("service:faucet") == {}
+        assert "faucet" not in await store.smembers("services")
+
+    run(flow())
+
+
+def test_services_stats_aggregation(capsys):
+    async def flow():
+        store = MemoryStore()
+        await store.set("stats:precache", "7")
+        await store.set("stats:ondemand", "3")
+        for name, public in (("a", "Y"), ("b", "N")):
+            await store.hset(
+                f"service:{name}",
+                {"api_key": "x", "precache": "2", "ondemand": "1", "public": public},
+            )
+            await store.sadd("services", name)
+        args = services.build_parser().parse_args(["stats"])
+        assert await services.stats(store, args) == 0
+
+    run(flow())
+    out = json.loads(capsys.readouterr().out)
+    assert out["work"] == {"precache": 7, "ondemand": 3}
+    assert out["services"]["a"]["public"] is True
+    assert out["services"]["b"]["ondemand"] == 1
+
+
+def test_open_store_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "state.json")
+
+    async def flow():
+        async with open_store(path) as store:
+            await store.set("k", "v")
+        async with open_store(path) as store:
+            assert await store.get("k") == "v"
+
+    run(flow())
+
+
+# ---------------------------------------------------------- client_snapshot
+
+
+def _seed_clients(store):
+    async def seed():
+        # busy client: 80 new works since last snapshot
+        await store.sadd("clients", VALID_ACCOUNT)
+        await store.hset(
+            f"client:{VALID_ACCOUNT}",
+            {"precache": "100", "ondemand": "30", "snapshot_precache": "50",
+             "snapshot_ondemand": "0"},
+        )
+        # idle client: below the 50-work threshold (reference :47)
+        lazy = nc.encode_account(bytes(32))
+        await store.sadd("clients", lazy)
+        await store.hset(f"client:{lazy}", {"precache": "10", "ondemand": "0"})
+        # junk address: skipped (reference :28-32)
+        await store.sadd("clients", "not_an_address")
+        await store.hset("client:not_an_address", {"ondemand": "1000"})
+        return lazy
+
+    return run(seed())
+
+
+def test_snapshot_thresholds_and_advance(tmp_path):
+    store = MemoryStore()
+    _seed_clients(store)
+
+    async def flow():
+        return await cs.snapshot(store, out_dir=str(tmp_path))
+
+    result = run(flow())
+    assert result["clients_eligible"] == 1
+    assert result["total_works"] == 80
+    payouts_data = json.load(open(result["payouts_file"]))
+    assert set(payouts_data) == {VALID_ACCOUNT}
+    assert payouts_data[VALID_ACCOUNT]["works"] == 80
+    assert "uuid" in payouts_data[VALID_ACCOUNT]
+    # snapshot fields advanced: immediate re-run finds nothing new
+    result2 = run(cs.snapshot(store, out_dir=str(tmp_path)))
+    assert result2["clients_eligible"] == 0
+
+
+def test_snapshot_dry_run_does_not_advance(tmp_path):
+    store = MemoryStore()
+    _seed_clients(store)
+    result = run(cs.snapshot(store, out_dir=str(tmp_path), dry_run=True))
+    assert result["clients_eligible"] == 1
+    result2 = run(cs.snapshot(store, out_dir=str(tmp_path)))
+    assert result2["clients_eligible"] == 1  # nothing was consumed
+
+
+def test_snapshot_exclude(tmp_path):
+    store = MemoryStore()
+    _seed_clients(store)
+    result = run(
+        cs.snapshot(store, out_dir=str(tmp_path), exclude=frozenset({VALID_ACCOUNT}))
+    )
+    assert result["clients_eligible"] == 0
+
+
+# ----------------------------------------------------------------- payouts
+
+
+def test_plan_payouts_proportional():
+    table = {
+        "a": {"works": 75, "uuid": "u1"},
+        "b": {"works": 25, "uuid": "u2"},
+    }
+    plan = payouts.plan_payouts(table, balance_raw=1000, fraction=0.5)
+    assert plan == {"a": 375, "b": 125}
+
+
+def test_plan_payouts_zero_works():
+    assert payouts.plan_payouts({}, 1000, 1.0) == {}
+    assert payouts.plan_payouts({"a": {"works": 0, "uuid": "u"}}, 1000, 1.0) == {}
+
+
+def test_plan_payouts_floors_dust():
+    table = {"a": {"works": 1, "uuid": "u1"}, "b": {"works": 10**6, "uuid": "u2"}}
+    plan = payouts.plan_payouts(table, balance_raw=10, fraction=1.0)
+    assert "a" not in plan  # sub-raw share floored away
+
+
+# ------------------------------------------------------------ check_latency
+
+
+def test_latency_probe_times_work_result_cancel():
+    async def flow():
+        broker = Broker()  # default users incl. dpowinterface observer
+        observer = InProcTransport(
+            broker, username="dpowinterface", password="dpowinterface"
+        )
+        probe = cl.LatencyProbe(observer, quiet=True)
+        server = InProcTransport(broker, username="dpowserver", password="dpowserver")
+        client = InProcTransport(broker, username="client", password="client")
+        await server.connect()
+        await client.connect()
+
+        runner = asyncio.ensure_future(probe.run())
+        await asyncio.sleep(0.05)
+        h1, h2 = "A" * 64, "B" * 64
+        await server.publish("work/ondemand", f"{h1},ffffffc000000000")
+        await server.publish("work/ondemand", f"{h2},ffffffc000000000")
+        await asyncio.sleep(0.02)
+        await client.publish("result/ondemand", f"{h1},deadbeef00000000,nano_xyz")
+        await server.publish("cancel/ondemand", h2)
+        await asyncio.sleep(0.05)
+        runner.cancel()
+        for t in (observer, server, client):
+            await t.close()
+        return probe
+
+    probe = run(flow())
+    assert len(probe.result_deltas) == 1
+    assert len(probe.cancel_deltas) == 1
+    assert probe.summary()["results"] == 1

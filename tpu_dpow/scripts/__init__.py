@@ -1,0 +1,38 @@
+"""Operator CLIs — parity with the reference's server/scripts/ suite.
+
+  services        — service-record CRUD + stats   (reference scripts/services.py)
+  client_snapshot — payout prep snapshots         (reference scripts/client_snapshot.py)
+  payouts         — proportional reward payouts   (reference scripts/payouts.py)
+  check_latency   — passive transport latency probe (reference scripts/check_latency.py)
+
+All of them talk to the same Store seam the server uses: pass
+``--store redis://...`` for a live deployment, or the path of a MemoryStore
+checkpoint file (server ``--checkpoint_path``) to inspect/mutate offline
+state — the test seam the reference's redis-only scripts never had.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import AsyncIterator
+
+from ..store import MemoryStore, Store, get_store
+
+
+@contextlib.asynccontextmanager
+async def open_store(uri: str) -> AsyncIterator[Store]:
+    """Open a store by URI; checkpoint-file stores persist mutations on exit."""
+    if uri.startswith("redis://") or uri == "memory":
+        store = get_store(uri)
+        await store.setup()
+        try:
+            yield store
+        finally:
+            await store.close()
+        return
+    # Anything else is a MemoryStore checkpoint path (load → mutate → save).
+    store = MemoryStore()
+    with contextlib.suppress(FileNotFoundError):
+        store.load(uri)
+    yield store
+    store.save(uri)

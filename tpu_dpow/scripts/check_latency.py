@@ -1,0 +1,107 @@
+"""Passive transport latency probe (reference server/scripts/check_latency.py).
+
+Subscribes to ``work/# result/# cancel/#`` (and ``statistics``) as an
+observer and times, per block hash, the deltas work→first-result and
+work→cancel — the live round-trip health of the swarm (reference
+check_latency.py:18-39). Works against any Transport; the default connects
+to a TCP broker as the dashboard user.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+from typing import Dict, Optional
+
+from ..transport import QOS_0, Transport
+from ..transport.tcp import TcpTransport
+
+
+class LatencyProbe:
+    def __init__(self, transport: Transport, *, quiet: bool = False):
+        self.transport = transport
+        self.quiet = quiet
+        self.work_sent: Dict[str, float] = {}
+        self.result_deltas: list = []
+        self.cancel_deltas: list = []
+
+    async def run(self, duration: Optional[float] = None) -> None:
+        await self.transport.connect()
+        for pattern in ("work/#", "result/#", "cancel/#", "statistics"):
+            await self.transport.subscribe(pattern, qos=QOS_0)
+        deadline = None if duration is None else time.monotonic() + duration
+        async for msg in self.transport.messages():
+            self.on_message(msg.topic, msg.payload)
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+
+    def on_message(self, topic: str, payload: str) -> None:
+        now = time.monotonic()
+        if topic.startswith("work/"):
+            block_hash = payload.split(",")[0]
+            self.work_sent.setdefault(block_hash, now)
+        elif topic.startswith("result/"):
+            block_hash = payload.split(",")[0]
+            start = self.work_sent.get(block_hash)
+            if start is not None:
+                delta = now - start
+                self.result_deltas.append(delta)
+                if not self.quiet:
+                    print(f"result {block_hash[:16]}… after {delta * 1000:.1f} ms")
+        elif topic.startswith("cancel/"):
+            block_hash = payload.strip()
+            start = self.work_sent.pop(block_hash, None)
+            if start is not None:
+                delta = now - start
+                self.cancel_deltas.append(delta)
+                if not self.quiet:
+                    print(f"cancel {block_hash[:16]}… after {delta * 1000:.1f} ms")
+        elif topic == "statistics" and not self.quiet:
+            print(f"statistics: {payload}")
+
+    def summary(self) -> dict:
+        def pct(xs, q):
+            return round(statistics.quantiles(xs, n=100)[q - 1] * 1000, 2) if len(xs) > 1 else None
+
+        return {
+            "results": len(self.result_deltas),
+            "cancels": len(self.cancel_deltas),
+            "result_p50_ms": pct(self.result_deltas, 50),
+            "result_p90_ms": pct(self.result_deltas, 90),
+            "cancel_p50_ms": pct(self.cancel_deltas, 50),
+        }
+
+
+async def amain(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1883)
+    p.add_argument("--username", default="dpowinterface")
+    p.add_argument("--password", default="dpowinterface")
+    p.add_argument("--duration", type=float, default=None, help="seconds; default forever")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+    transport = TcpTransport(
+        args.host, args.port, username=args.username, password=args.password
+    )
+    probe = LatencyProbe(transport, quiet=args.quiet)
+    try:
+        await probe.run(args.duration)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await transport.close()
+    print(json.dumps(probe.summary()))
+    return 0
+
+
+def main(argv=None) -> int:
+    return asyncio.run(amain(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Payout-prep snapshots of client work counters
+(reference server/scripts/client_snapshot.py).
+
+Diffs each ``client:{addr}`` counter hash against its ``snapshot_*`` fields,
+skips clients below the minimum-work threshold (reference :47) and clients
+with invalid payout addresses (reference :28-32), then emits two timestamped
+JSON files:
+
+  payouts_<ts>.json  — {address: {"works": n, "uuid": ...}} for the payer
+  snapshot_<ts>.json — full counter state for the audit trail
+
+and advances the ``snapshot_*`` fields so the next run starts from zero. The
+per-payout uuid doubles as the idempotent node ``send`` id downstream
+(reference payouts.py:95).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+import uuid
+
+from ..utils import nanocrypto as nc
+from . import open_store
+
+MIN_WORKS = 50  # reference client_snapshot.py:47
+WORK_FIELDS = ("precache", "ondemand")
+
+
+async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
+                   exclude: frozenset = frozenset(), dry_run: bool = False) -> dict:
+    ts = int(time.time())
+    payouts: dict = {}
+    snap: dict = {}
+    for addr in sorted(await store.smembers("clients")):
+        record = await store.hgetall(f"client:{addr}")
+        snap[addr] = dict(record)
+        if addr in exclude:
+            continue
+        try:
+            nc.validate_account(addr)
+        except nc.InvalidAccount:
+            print(f"skipping invalid payout address {addr!r}", file=sys.stderr)
+            continue
+        new_works = sum(
+            int(record.get(f, 0)) - int(record.get(f"snapshot_{f}", 0))
+            for f in WORK_FIELDS
+        )
+        if new_works < min_works:
+            continue
+        payouts[addr] = {"works": new_works, "uuid": str(uuid.uuid4())}
+        if not dry_run:
+            await store.hset(
+                f"client:{addr}",
+                {f"snapshot_{f}": record.get(f, "0") for f in WORK_FIELDS},
+            )
+
+    payouts_path = f"{out_dir}/payouts_{ts}.json"
+    snapshot_path = f"{out_dir}/snapshot_{ts}.json"
+    if not dry_run:
+        with open(payouts_path, "w") as f:
+            json.dump(payouts, f, indent=2)
+        with open(snapshot_path, "w") as f:
+            json.dump(snap, f, indent=2)
+    return {
+        "clients_eligible": len(payouts),
+        "total_works": sum(p["works"] for p in payouts.values()),
+        "payouts_file": payouts_path,
+        "snapshot_file": snapshot_path,
+        "dry_run": dry_run,
+    }
+
+
+async def amain(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--store", default="redis://localhost")
+    p.add_argument("--min_works", type=int, default=MIN_WORKS)
+    p.add_argument("--out_dir", default=".")
+    p.add_argument("--exclude", nargs="*", default=[],
+                   help="payout addresses to skip (e.g. the hub's own account)")
+    p.add_argument("--dry_run", action="store_true")
+    args = p.parse_args(argv)
+    async with open_store(args.store) as store:
+        result = await snapshot(
+            store,
+            min_works=args.min_works,
+            out_dir=args.out_dir,
+            exclude=frozenset(args.exclude),
+            dry_run=args.dry_run,
+        )
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    return asyncio.run(amain(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
