@@ -172,3 +172,26 @@ def test_registry():
     assert isinstance(get_backend("jax", kernel="xla"), JaxWorkBackend)
     with pytest.raises(ValueError):
         get_backend("quantum")
+
+
+def test_one_waiter_timeout_does_not_kill_dedup_waiters(backend):
+    """A shared job survives one waiter's cancellation (waiter refcount)."""
+
+    async def run():
+        await backend.setup()
+        h = random_hash()
+        # Waiter A is cancelled outright; waiter B (sharing the job) stays.
+        task_a = asyncio.ensure_future(backend.generate(WorkRequest(h, EASY)))
+        await asyncio.sleep(0)
+        task_b = asyncio.ensure_future(backend.generate(WorkRequest(h, EASY)))
+        await asyncio.sleep(0)
+        task_a.cancel()
+        try:
+            await task_a  # may have won the race and completed — fine
+        except asyncio.CancelledError:
+            pass
+        work = await asyncio.wait_for(task_b, timeout=30)
+        nc.validate_work(h, work, EASY)
+        await backend.close()
+
+    asyncio.run(run())

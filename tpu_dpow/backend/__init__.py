@@ -19,7 +19,8 @@ async protocol with three interchangeable engines:
 from __future__ import annotations
 
 import abc
-from typing import Optional
+import asyncio
+from typing import Callable, Optional
 
 from ..models import WorkRequest
 
@@ -30,6 +31,27 @@ class WorkError(Exception):
 
 class WorkCancelled(WorkError):
     """The in-flight request was cancelled (reference work_cancel analog)."""
+
+
+async def await_shared_job(job, abort: Callable[[], None]) -> str:
+    """Wait on a shared (deduped) job with last-waiter-out cancellation.
+
+    ``job`` needs ``.future`` and a ``.waiters`` int. Concurrent generates
+    for one hash share a single search job (the reference dedups on enqueue,
+    client/work_handler.py:84-89); one impatient waiter — e.g. a wait_for
+    timeout — must not tear down work others still share. Only when the last
+    waiter gives up does ``abort`` run (backend-specific scan teardown) and
+    the future get cancelled.
+    """
+    job.waiters += 1
+    try:
+        return await asyncio.shield(job.future)
+    except asyncio.CancelledError:
+        job.waiters -= 1
+        if job.waiters <= 0 and not job.future.done():
+            abort()
+            job.future.cancel()
+        raise
 
 
 class WorkBackend(abc.ABC):

@@ -40,7 +40,7 @@ import numpy as np
 from ..models import WorkRequest
 from ..ops import pallas_kernel, search
 from ..utils import nanocrypto as nc
-from . import WorkBackend, WorkCancelled, WorkError
+from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
 
 _UNREACHABLE = (1 << 64) - 1  # padding difficulty: P(hit) = 2^-64 per hash
 _MASK64 = (1 << 64) - 1
@@ -55,6 +55,7 @@ class _Job:
     base: int
     cancelled: bool = False
     hashes_done: int = 0
+    waiters: int = 0  # refcount: last cancelled waiter drops the job
 
     def set_base(self, base: int) -> None:
         self.base = base & _MASK64
@@ -122,7 +123,7 @@ class JaxWorkBackend(WorkBackend):
             # then satisfies every waiter; a weaker/equal one just shares.
             if request.difficulty > existing.difficulty:
                 existing.set_difficulty(request.difficulty)
-            return await asyncio.shield(existing.future)
+            return await self._await_job(existing)
         job = _Job(
             block_hash=key,
             difficulty=request.difficulty,
@@ -134,15 +135,13 @@ class JaxWorkBackend(WorkBackend):
         self._jobs[key] = job
         self._ensure_engine()
         self._wakeup.set()
-        try:
-            return await asyncio.shield(job.future)
-        except asyncio.CancelledError:
-            # Waiter gave up (e.g. wait_for timeout): finish the job as
-            # cancelled so the engine can drop it instead of spinning on it.
+        return await self._await_job(job)
+
+    async def _await_job(self, job: _Job) -> str:
+        def abort():  # engine drops cancelled jobs from the next pack
             job.cancelled = True
-            if not job.future.done():
-                job.future.cancel()
-            raise
+
+        return await await_shared_job(job, abort)
 
     async def cancel(self, block_hash: str) -> None:
         job = self._jobs.get(nc.validate_block_hash(block_hash))

@@ -43,11 +43,15 @@ class DpowClient:
     ):
         self.config = config
         self.transport = transport
-        backend = backend or get_backend(
-            config.backend,
-            **({"uri": config.worker_uri} if config.backend == "subprocess" else
-               {"max_batch": config.max_batch}),
-        )
+        if backend is None:
+            # Per-backend knobs: batching is the jax engine's concept, the
+            # worker URI the subprocess backend's; native takes neither.
+            kwargs = {}
+            if config.backend == "subprocess":
+                kwargs["uri"] = config.worker_uri
+            elif config.backend == "jax":
+                kwargs["max_batch"] = config.max_batch
+            backend = get_backend(config.backend, **kwargs)
         self.work_handler = WorkHandler(backend, self._send_result)
         self.last_heartbeat: Optional[float] = None
         self._server_online = True
