@@ -1,0 +1,72 @@
+"""Batch-64 concurrent hashes on one chip (BASELINE.json config 2).
+
+Packs B concurrent (hash, difficulty) requests into the backend's single
+batched launch path and times until all complete — the device-side analog of
+the reference's request-level asyncio concurrency (SURVEY.md §2.5). Reports
+aggregate solves/sec and the completion-time spread across the batch.
+
+Usage: python benchmarks/batch.py [--batch 64] [--multiplier 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+from tpu_dpow.backend.jax_backend import JaxWorkBackend
+from tpu_dpow.models import WorkRequest
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0xB4)
+
+
+async def run(batch: int, difficulty: int) -> None:
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        difficulty = min(difficulty, 0xFFF0000000000000)
+        backend = JaxWorkBackend(kernel="xla", sublanes=8, iters=8, max_batch=batch)
+    else:
+        backend = JaxWorkBackend(max_batch=batch)
+    await backend.setup()
+    hashes = [RNG.bytes(32).hex().upper() for _ in range(batch)]
+    done_at: dict = {}
+    t0 = time.perf_counter()
+
+    async def one(h: str) -> None:
+        work = await backend.generate(WorkRequest(h, difficulty))
+        done_at[h] = time.perf_counter() - t0
+        nc.validate_work(h, work, difficulty)
+
+    await asyncio.gather(*(one(h) for h in hashes))
+    total = max(done_at.values())
+    times = np.asarray(sorted(done_at.values())) * 1e3
+    await backend.close()
+    print(
+        json.dumps(
+            {
+                "bench": "batch_concurrent",
+                "batch": batch,
+                "difficulty": f"{difficulty:016x}",
+                "total_s": round(total, 3),
+                "solves_per_sec": round(batch / total, 2),
+                "first_done_ms": round(float(times[0]), 1),
+                "p50_done_ms": round(float(np.percentile(times, 50)), 1),
+                "last_done_ms": round(float(times[-1]), 1),
+                "device_hashes": backend.total_hashes,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--multiplier", type=float, default=1.0)
+    args = p.parse_args()
+    asyncio.run(run(args.batch, nc.derive_work_difficulty(args.multiplier)))

@@ -1,0 +1,130 @@
+"""End-to-end request flood (BASELINE.json config 4).
+
+The rebuild's version of the reference's manual load test — 20 parallel
+POSTs from ``service/many_requests.sh`` — scaled up: N concurrent service
+requests through the real stack (HTTP service API → server orchestration →
+in-process broker → worker client → batched device backend → result →
+winner election → HTTP response), measuring requests/sec and round-trip
+percentiles. Cancel fan-out and batch masking are on the measured path.
+
+Usage: python benchmarks/flood.py [--n 100] [--concurrency 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import aiohttp
+import numpy as np
+
+from tpu_dpow.backend.jax_backend import JaxWorkBackend
+from tpu_dpow.client import ClientConfig, DpowClient
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.server.api import ServerRunner
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import default_users
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0xF1)
+PAYOUT = nc.encode_account(bytes(range(32)))
+
+
+async def run(n: int, concurrency: int) -> None:
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    base_difficulty = nc.BASE_DIFFICULTY if on_tpu else 0xFF00000000000000
+
+    broker = Broker(users=default_users())
+    config = ServerConfig(
+        base_difficulty=base_difficulty,
+        throttle=100000.0,
+        heartbeat_interval=0.5,
+        statistics_interval=3600.0,
+        default_timeout=30.0,
+        service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+    )
+    store = MemoryStore()
+    server = DpowServer(config, store, InProcTransport(broker, client_id="server"))
+    runner = ServerRunner(server, config)
+    await runner.start()
+    await store.hset(
+        "service:bench",
+        {"api_key": hash_key("bench"), "public": "N", "display": "bench",
+         "website": "", "precache": "0", "ondemand": "0"},
+    )
+    await store.sadd("services", "bench")
+
+    backend = (
+        JaxWorkBackend()
+        if on_tpu
+        else JaxWorkBackend(kernel="xla", sublanes=8, iters=8, max_batch=32)
+    )
+    client = DpowClient(
+        ClientConfig(payout_address=PAYOUT, startup_heartbeat_wait=3.0),
+        InProcTransport(broker, client_id="worker", clean_session=False),
+        backend=backend,
+    )
+    await client.setup()
+    client.start_loops()
+
+    port = runner.ports["service"]
+    url = f"http://127.0.0.1:{port}/service/"
+    sem = asyncio.Semaphore(concurrency)
+    times: list = []
+    errors = [0]
+
+    async def one(session: aiohttp.ClientSession) -> None:
+        body = {
+            "user": "bench",
+            "api_key": "bench",
+            "hash": RNG.bytes(32).hex().upper(),
+            "timeout": 30,
+        }
+        async with sem:
+            t0 = time.perf_counter()
+            async with session.post(url, json=body) as resp:
+                data = await resp.json()
+            if "work" in data:
+                times.append(time.perf_counter() - t0)
+            else:
+                errors[0] += 1
+
+    t0 = time.perf_counter()
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*(one(session) for _ in range(n)))
+    wall = time.perf_counter() - t0
+
+    await client.close()
+    await runner.stop()
+
+    ms = np.asarray(sorted(times)) * 1e3
+    print(
+        json.dumps(
+            {
+                "bench": "e2e_flood",
+                "platform": "tpu" if on_tpu else "cpu",
+                "n": n,
+                "concurrency": concurrency,
+                "ok": len(times),
+                "errors": errors[0],
+                "wall_s": round(wall, 3),
+                "req_per_sec": round(len(times) / wall, 2),
+                "p50_ms": round(float(np.percentile(ms, 50)), 1) if len(times) else None,
+                "p95_ms": round(float(np.percentile(ms, 95)), 1) if len(times) else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=100)
+    p.add_argument("--concurrency", type=int, default=20)
+    args = p.parse_args()
+    asyncio.run(run(args.n, args.concurrency))

@@ -1,0 +1,73 @@
+"""Single-request work latency through the full backend path.
+
+BASELINE.json configs 1 and 3: one request at a time at base difficulty
+(config 1) or an 8x multiplier (config 3, the hard-send threshold), timing
+request->work through the real WorkBackend (engine loop, chunked launches,
+host validation) rather than raw kernel dispatches. Prints p50/p95 over N
+solves — the number that must land under 50 ms on a v5e-8 for the north
+star.
+
+Usage: python benchmarks/latency.py [--n 20] [--multiplier 1.0]
+       [--backend jax|native] [--difficulty HEX]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+from tpu_dpow.backend import get_backend
+from tpu_dpow.models import WorkRequest
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0xD0)
+
+
+async def run(n: int, difficulty: int, backend_name: str) -> None:
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if backend_name == "jax" and not on_tpu:
+        difficulty = min(difficulty, 0xFFF0000000000000)  # keep CPU runs sane
+    backend = get_backend(backend_name)
+    await backend.setup()
+    times = []
+    for _ in range(n):
+        h = RNG.bytes(32).hex().upper()
+        t0 = time.perf_counter()
+        work = await backend.generate(WorkRequest(h, difficulty))
+        times.append(time.perf_counter() - t0)
+        nc.validate_work(h, work, difficulty)
+    await backend.close()
+    ms = np.asarray(sorted(times)) * 1e3
+    print(
+        json.dumps(
+            {
+                "bench": "single_request_latency",
+                "backend": backend_name,
+                "difficulty": f"{difficulty:016x}",
+                "n": n,
+                "p50_ms": round(float(np.percentile(ms, 50)), 2),
+                "p95_ms": round(float(np.percentile(ms, 95)), 2),
+                "mean_ms": round(float(ms.mean()), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=20)
+    p.add_argument("--multiplier", type=float, default=1.0)
+    p.add_argument("--difficulty", default=None, help="hex override")
+    p.add_argument("--backend", default="jax", choices=["jax", "native"])
+    args = p.parse_args()
+    if args.difficulty:
+        diff = int(args.difficulty, 16)
+    else:
+        diff = nc.derive_work_difficulty(args.multiplier)
+    asyncio.run(run(args.n, diff, args.backend))

@@ -1,0 +1,114 @@
+"""Multi-chip sharded nonce search (BASELINE.json config 5).
+
+Times the ganged shard_map launch and the device-resident multi-step
+while_loop over an N-device (batch, nonce) mesh — the path that wins the
+<50 ms p50 target at 2^29-expected-hash difficulty (SURVEY.md §7 hard part
+#3). On a machine without N real chips, run with virtual devices:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+      python benchmarks/multichip.py --devices 8
+
+Usage: python benchmarks/multichip.py [--devices 8] [--batch-shards 1]
+       [--chunk-per-shard 65536] [--reps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def run(n_devices: int, batch_shards: int, chunk_per_shard: int, reps: int) -> None:
+    import jax
+
+    from tpu_dpow.ops import search
+    from tpu_dpow.parallel import (
+        make_mesh,
+        replicate_params,
+        sharded_search_chunk_batch,
+        sharded_search_run,
+    )
+
+    devices = jax.devices()[:n_devices]
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"need {n_devices} devices, have {len(devices)}; "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count"
+        )
+    on_tpu = devices[0].platform == "tpu"
+    if not on_tpu:
+        chunk_per_shard = min(chunk_per_shard, 1024)
+    mesh = make_mesh(devices, batch_shards=batch_shards)
+    n_nonce = mesh.shape["nonce"]
+    batch = max(4, batch_shards)
+
+    rows = np.stack(
+        [
+            search.pack_params(bytes([i] * 32), (1 << 64) - 1, i << 40)
+            for i in range(batch)
+        ]
+    )
+    params = replicate_params(rows, mesh)
+
+    # Ganged single-window launch.
+    np.asarray(
+        sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=chunk_per_shard)
+    )
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = sharded_search_chunk_batch(
+            params, mesh=mesh, chunk_per_shard=chunk_per_shard
+        )
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    window = chunk_per_shard * n_nonce * batch
+    print(
+        json.dumps(
+            {
+                "bench": "multichip_ganged_launch",
+                "platform": devices[0].platform,
+                "devices": n_devices,
+                "mesh": {"batch": batch_shards, "nonce": n_nonce},
+                "chunk_per_shard": chunk_per_shard,
+                "hs_aggregate": round(reps * window / dt, 1),
+                "launch_ms": round(dt / reps * 1e3, 3),
+            }
+        )
+    )
+
+    # Device-resident multi-step loop (dispatch amortization).
+    steps = 4
+    np.asarray(
+        sharded_search_run(
+            params, mesh=mesh, chunk_per_shard=chunk_per_shard, max_steps=steps
+        )[0]
+    )
+    t0 = time.perf_counter()
+    lo, _ = sharded_search_run(
+        params, mesh=mesh, chunk_per_shard=chunk_per_shard, max_steps=steps
+    )
+    np.asarray(lo)
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "bench": "multichip_resident_loop",
+                "steps": steps,
+                "hs_aggregate": round(steps * window / dt, 1),
+                "total_ms": round(dt * 1e3, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--batch-shards", type=int, default=1)
+    p.add_argument("--chunk-per-shard", type=int, default=65536)
+    p.add_argument("--reps", type=int, default=8)
+    args = p.parse_args()
+    run(args.devices, args.batch_shards, args.chunk_per_shard, args.reps)

@@ -1,0 +1,107 @@
+"""Geometry sweep: Blake2b scan throughput vs Pallas launch shape.
+
+BASELINE.json north star: >= 1e9 H/s/chip on v5e. The launch geometry
+(sublanes x 128 lanes x iters) trades VPU occupancy against early-exit and
+cancel latency; this sweep finds the knee. Also times the native C++ engine
+(backend=native) for a host-CPU reference point.
+
+Usage: python benchmarks/throughput.py [--reps 8] [--native]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def sweep_jax(reps: int) -> None:
+    import jax
+
+    from tpu_dpow.ops import pallas_kernel, search
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    params = np.stack([search.pack_params(bytes(range(32)), (1 << 64) - 1, 0)])
+    pj = jax.device_put(params, dev)
+
+    if on_tpu:
+        geometries = [(s, i) for s in (8, 16, 32, 64, 128) for i in (64, 256, 1024)]
+    else:
+        geometries = [(8, 8)]  # CPU smoke shape
+
+    for sublanes, iters in geometries:
+        chunk = sublanes * 128 * iters
+
+        def launch():
+            if on_tpu:
+                return pallas_kernel.pallas_search_chunk_batch(
+                    pj, sublanes=sublanes, iters=iters
+                )
+            return search.search_chunk_batch(pj, chunk_size=chunk)
+
+        np.asarray(launch())  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = launch()
+        np.asarray(out)
+        dt = time.perf_counter() - t0
+        print(
+            json.dumps(
+                {
+                    "bench": "throughput_geometry",
+                    "platform": dev.platform,
+                    "sublanes": sublanes,
+                    "iters": iters,
+                    "chunk": chunk,
+                    "hs": round(reps * chunk / dt, 1),
+                    "launch_ms": round(dt / reps * 1e3, 3),
+                }
+            )
+        )
+
+
+def sweep_native(reps: int) -> None:
+    import ctypes
+    import os
+
+    from tpu_dpow.backend import native_backend as nb
+
+    lib = nb.load_library()
+    h = bytes(range(32))
+    nonce_out = ctypes.c_uint64(0)
+    done = ctypes.c_uint64(0)
+    count = 1 << 22
+    for threads in {1, max(1, (os.cpu_count() or 1) // 2), os.cpu_count() or 1}:
+        lib.bw_search_range(  # warm the thread pool path
+            h, (1 << 64) - 1, 0, 1 << 16, threads, None,
+            ctypes.byref(nonce_out), ctypes.byref(done),
+        )
+        t0 = time.perf_counter()
+        for r in range(reps):
+            lib.bw_search_range(
+                h, (1 << 64) - 1, r * count, count, threads, None,
+                ctypes.byref(nonce_out), ctypes.byref(done),
+            )
+        dt = time.perf_counter() - t0
+        print(
+            json.dumps(
+                {
+                    "bench": "throughput_native",
+                    "threads": threads,
+                    "hs": round(reps * count / dt, 1),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--reps", type=int, default=8)
+    p.add_argument("--native", action="store_true", help="also time the C++ engine")
+    args = p.parse_args()
+    sweep_jax(args.reps)
+    if args.native:
+        sweep_native(args.reps)

@@ -1,0 +1,89 @@
+"""Standalone broker entrypoint: users file parsing + end-to-end TCP broker.
+
+Deployment-parity coverage for setup/broker/users.json — the rebuild's
+Mosquitto password/ACL files (reference server/setup/mosquitto/acls:1-33).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tpu_dpow.transport import QOS_0
+from tpu_dpow.transport.__main__ import load_users
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.tcp import TcpBrokerServer, TcpTransport
+
+
+def test_load_users_skips_comment_keys(tmp_path):
+    path = tmp_path / "users.json"
+    path.write_text(
+        json.dumps(
+            {
+                "_comment": "ignored",
+                "alice": {"password": "pw", "acl_pub": ["work/#"], "acl_sub": ["result/#"]},
+            }
+        )
+    )
+    users = load_users(str(path))
+    assert set(users) == {"alice"}
+    assert users["alice"].password == "pw"
+    assert users["alice"].acl_pub == ("work/#",)
+
+
+def test_shipped_users_template_parses():
+    users = load_users("setup/broker/users.json")
+    assert {"dpowserver", "client", "dpowinterface"} <= set(users)
+    assert "work/#" in users["dpowserver"].acl_pub
+    assert "result/#" in users["client"].acl_pub
+    assert users["dpowinterface"].acl_pub == ()
+
+
+def test_broker_with_users_file_end_to_end(tmp_path):
+    """Boot a TCP broker from a users file; pub/sub through it."""
+    path = tmp_path / "users.json"
+    path.write_text(
+        json.dumps(
+            {
+                "srv": {"password": "s", "acl_pub": ["work/#"], "acl_sub": ["result/#"]},
+                "wrk": {"password": "w", "acl_pub": ["result/#"], "acl_sub": ["work/#"]},
+            }
+        )
+    )
+
+    async def run():
+        broker = Broker(users=load_users(str(path)))
+        server = TcpBrokerServer(broker, host="127.0.0.1", port=0)
+        await server.start()
+        port = server.port
+        try:
+            srv = TcpTransport.from_uri(
+                f"tcp://srv:s@127.0.0.1:{port}", client_id="srv"
+            )
+            wrk = TcpTransport.from_uri(
+                f"tcp://wrk:w@127.0.0.1:{port}", client_id="wrk"
+            )
+            await srv.connect()
+            await wrk.connect()
+            await wrk.subscribe("work/#", QOS_0)
+            got = asyncio.Event()
+            seen = {}
+
+            async def listen():
+                async for msg in wrk.messages():
+                    seen["msg"] = msg
+                    got.set()
+                    break
+
+            task = asyncio.ensure_future(listen())
+            await asyncio.sleep(0.05)
+            await srv.publish("work/ondemand", "AB,ffffffc000000000", QOS_0)
+            await asyncio.wait_for(got.wait(), timeout=2)
+            assert seen["msg"].topic == "work/ondemand"
+            task.cancel()
+            await srv.close()
+            await wrk.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())

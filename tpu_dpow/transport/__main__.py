@@ -1,0 +1,81 @@
+"""Standalone broker: ``python -m tpu_dpow.transport [--listen ...] [--users ...]``.
+
+The rebuild's deployable stand-in for the reference's Mosquitto process
+(reference server/setup/mosquitto/dpow.conf + acls): a TCP pub/sub broker
+with the same topic contract, QoS levels, and per-user ACL matrix, but run
+from this package instead of an external C daemon. Single-host deployments
+can skip it entirely (`--inproc_broker` on the server embeds one); this
+entrypoint exists for multi-host swarms where workers connect over the
+network.
+
+The users file is JSON:
+
+    {"dpowserver": {"password": "...",
+                    "acl_pub": ["work/#", "..."],
+                    "acl_sub": ["result/#"]}, ...}
+
+Absent a users file, the default dpowserver/client/dpowinterface matrix from
+transport.default_users() applies (mirroring reference
+server/setup/mosquitto/acls:1-33); see setup/broker/users.json for the
+deployable template.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+
+from . import User, default_users
+from .broker import Broker
+from .tcp import TcpBrokerServer
+
+
+def load_users(path: str) -> dict:
+    with open(path) as f:
+        raw = json.load(f)
+    return {
+        name: User(
+            password=u["password"],
+            acl_pub=tuple(u.get("acl_pub", ())),
+            acl_sub=tuple(u.get("acl_sub", ())),
+        )
+        for name, u in raw.items()
+        if not name.startswith("_")  # "_comment" and friends
+    }
+
+
+async def amain(argv=None) -> None:
+    p = argparse.ArgumentParser("tpu-dpow broker")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=1883)
+    p.add_argument("--users", default=None, help="path to users JSON")
+    p.add_argument("--verbose", action="store_true")
+    ns = p.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if ns.verbose else logging.INFO)
+
+    users = load_users(ns.users) if ns.users else default_users()
+    broker = Broker(users=users)
+    server = TcpBrokerServer(broker, host=ns.host, port=ns.port)
+    await server.start()
+    logging.getLogger(__name__).info(
+        "broker listening on %s:%d (%d users)", ns.host, ns.port, len(users)
+    )
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(amain(argv))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
