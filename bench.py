@@ -40,12 +40,16 @@ def measure(reps: int = 8) -> dict:
     )
 
     if on_tpu:
-        sublanes, iters = 64, 1024
-        chunk = sublanes * 128 * iters
+        # v5e-tuned geometry (benchmarks/throughput.py sweep): a 32x128
+        # tile, 1024 inner iterations, 64 sequential windows per dispatch
+        # (early-exit check every 8 tiles) — the persistent-kernel shape
+        # that amortizes the ~8 ms dispatch/tunnel floor.
+        sublanes, iters, nblocks, group = 32, 1024, 64, 8
+        chunk = sublanes * 128 * iters * nblocks
 
         def launch(p):
             return pallas_kernel.pallas_search_chunk_batch(
-                p, sublanes=sublanes, iters=iters
+                p, sublanes=sublanes, iters=iters, nblocks=nblocks, group=group
             )
 
     else:

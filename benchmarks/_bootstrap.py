@@ -1,0 +1,12 @@
+"""Put the repo root on sys.path so `python benchmarks/x.py` finds tpu_dpow.
+
+Scripts import this as their first import; the script's own directory is
+sys.path[0], so `import _bootstrap` resolves here without the repo root.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

@@ -10,6 +10,8 @@ Usage: python benchmarks/batch.py [--batch 64] [--multiplier 1.0]
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import argparse
 import asyncio
 import json

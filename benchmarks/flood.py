@@ -12,6 +12,8 @@ Usage: python benchmarks/flood.py [--n 100] [--concurrency 20]
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import argparse
 import asyncio
 import json

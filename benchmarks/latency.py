@@ -13,6 +13,8 @@ Usage: python benchmarks/latency.py [--n 20] [--multiplier 1.0]
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import argparse
 import asyncio
 import json

@@ -14,6 +14,8 @@ Usage: python benchmarks/multichip.py [--devices 8] [--batch-shards 1]
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import argparse
 import json
 import time

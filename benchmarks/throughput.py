@@ -10,6 +10,8 @@ Usage: python benchmarks/throughput.py [--reps 8] [--native]
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import argparse
 import json
 import time
@@ -28,17 +30,28 @@ def sweep_jax(reps: int) -> None:
     pj = jax.device_put(params, dev)
 
     if on_tpu:
-        geometries = [(s, i) for s in (8, 16, 32, 64, 128) for i in (64, 256, 1024)]
+        # (sublanes, iters, nblocks, group): single-window tile scan first,
+        # then the multi-window persistent-kernel shapes that amortize the
+        # ~8 ms dispatch floor — the bench.py/backend defaults come from
+        # this grid, so re-running it re-derives them.
+        geometries = [
+            (s, i, 1, 1) for s in (8, 16, 32, 64, 128) for i in (64, 256, 1024)
+        ] + [
+            (32, 1024, nb, g) for nb in (8, 32, 64) for g in (1, 8)
+        ] + [
+            (64, 1024, 16, 8), (16, 1024, 128, 8),
+        ]
     else:
-        geometries = [(8, 8)]  # CPU smoke shape
+        geometries = [(8, 8, 1, 1)]  # CPU smoke shape
 
-    for sublanes, iters in geometries:
-        chunk = sublanes * 128 * iters
+    for sublanes, iters, nblocks, group in geometries:
+        chunk = sublanes * 128 * iters * nblocks
 
         def launch():
             if on_tpu:
                 return pallas_kernel.pallas_search_chunk_batch(
-                    pj, sublanes=sublanes, iters=iters
+                    pj, sublanes=sublanes, iters=iters, nblocks=nblocks,
+                    group=group,
                 )
             return search.search_chunk_batch(pj, chunk_size=chunk)
 
@@ -55,6 +68,8 @@ def sweep_jax(reps: int) -> None:
                     "platform": dev.platform,
                     "sublanes": sublanes,
                     "iters": iters,
+                    "nblocks": nblocks,
+                    "group": group,
                     "chunk": chunk,
                     "hs": round(reps * chunk / dt, 1),
                     "launch_ms": round(dt / reps * 1e3, 3),

@@ -102,3 +102,32 @@ def test_work_hex_convention():
     # nano work hex is the big-endian rendering of the u64 nonce
     assert search.work_hex_from_nonce(0x123456789ABCDEF0) == "123456789abcdef0"
     assert search.nonce_from_offset((1 << 64) - 1, 2) == 1
+
+
+def test_pallas_interpret_multiblock_matches_single_window():
+    """nblocks>1 + group>1: one dispatch over consecutive windows, same
+    result as one big single-window scan (the persistent-kernel mode that
+    amortizes dispatch overhead on real hardware)."""
+    hashes = [RNG.bytes(32) for _ in range(2)]
+    sub, it, nb, grp = 8, 4, 4, 2
+    total = sub * 128 * it * nb
+    params = np.stack([search.pack_params(h, EASY, base=123) for h in hashes])
+    got = np.asarray(
+        pallas_kernel.pallas_search_chunk_batch(
+            jnp.asarray(params),
+            sublanes=sub, iters=it, nblocks=nb, group=grp, interpret=True,
+        )
+    )
+    for i in range(2):
+        want = int(search.search_chunk(jnp.asarray(params[i]), chunk_size=total))
+        assert got[i] == want, (i, got[i], want)
+
+
+def test_pallas_interpret_multiblock_sentinel_when_dry():
+    params = np.stack([search.pack_params(bytes(32), (1 << 64) - 1, base=0)])
+    got = np.asarray(
+        pallas_kernel.pallas_search_chunk_batch(
+            jnp.asarray(params), sublanes=8, iters=4, nblocks=3, interpret=True
+        )
+    )
+    assert got[0] == search.SENTINEL

@@ -75,8 +75,10 @@ class JaxWorkBackend(WorkBackend):
         self,
         *,
         kernel: Optional[str] = None,  # 'pallas' | 'xla' | None = auto
-        sublanes: int = 64,
-        iters: int = 512,
+        sublanes: int = 32,
+        iters: int = 1024,
+        nblocks: int = 8,
+        group: int = 8,
         max_batch: int = 16,
         interpret: bool = False,
         device: Optional[jax.Device] = None,
@@ -84,13 +86,21 @@ class JaxWorkBackend(WorkBackend):
         self.device = device or jax.devices()[0]
         on_tpu = self.device.platform == "tpu"
         self.kernel = kernel or ("pallas" if on_tpu else "xla")
+        # Defaults follow the v5e geometry sweep (benchmarks/throughput.py):
+        # (32 sublanes, 1024 iters, group 8) sustains >1 GH/s; nblocks sets
+        # the per-dispatch window — 8 windows ≈ 33.5 M nonces ≈ 30 ms of
+        # scan per launch, the cancel-latency/throughput tradeoff point.
         self.sublanes = sublanes
         self.iters = iters
+        self.nblocks = nblocks
+        self.group = group
         if self.kernel == "xla" and not on_tpu:
             # CPU fallback/test path: small chunks keep latency sane.
             self.sublanes = min(sublanes, 8)
             self.iters = min(iters, 8)
-        self.chunk = self.sublanes * 128 * self.iters
+            self.nblocks = 1
+            self.group = 1
+        self.chunk = self.sublanes * 128 * self.iters * self.nblocks
         self.max_batch = max_batch
         self.interpret = interpret
         self._jobs: Dict[str, _Job] = {}
@@ -174,6 +184,8 @@ class JaxWorkBackend(WorkBackend):
                 pj,
                 sublanes=self.sublanes,
                 iters=self.iters,
+                nblocks=self.nblocks,
+                group=self.group,
                 interpret=self.interpret,
             )
         else:
