@@ -1,0 +1,117 @@
+"""Standalone work server: the nano-work-server wire protocol over any backend.
+
+The reference vendors a Rust/OpenCL binary serving HTTP JSON-RPC on
+127.0.0.1:7000 (reference client/bin, client/README.md:31; API observed at
+client/work_handler.py:75-78,104-108). This module is that process rebuilt
+around this framework's engines: any ``WorkBackend`` (TPU, native C++,
+even another subprocess) behind the same three-verb contract —
+
+    {"action": "work_generate", "hash": H, "difficulty": D} → {"work": W}
+    {"action": "work_cancel",   "hash": H}                  → {}
+    anything else                                           → {"error": ...}
+
+so a *reference* deployment can point its unmodified Python client at this
+server and get TPU-computed work, closing the compatibility loop in both
+directions (our SubprocessWorkBackend already speaks this protocol as a
+client). ``work_validate`` is a small extension the reference server lacks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..backend import WorkBackend, WorkCancelled, WorkError
+from ..models import WorkRequest
+from ..utils import nanocrypto as nc
+
+logger = logging.getLogger(__name__)
+
+
+def build_app(backend: WorkBackend) -> web.Application:
+    async def handler(request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+        except Exception:
+            return web.json_response({"error": "Bad request (not json)"})
+        if not isinstance(data, dict):
+            return web.json_response({"error": "Bad request (not json object)"})
+        action = data.get("action")
+        try:
+            if action == "work_generate":
+                block_hash = nc.validate_block_hash(str(data.get("hash", "")))
+                difficulty = int(
+                    nc.validate_difficulty(
+                        str(data.get("difficulty", f"{nc.BASE_DIFFICULTY:016x}"))
+                    ),
+                    16,
+                )
+                work = await backend.generate(WorkRequest(block_hash, difficulty))
+                value = nc.work_value(block_hash, work)
+                return web.json_response(
+                    {
+                        "work": work,
+                        "difficulty": f"{value:016x}",
+                        "multiplier": str(nc.derive_work_multiplier(value)),
+                    }
+                )
+            if action == "work_cancel":
+                block_hash = nc.validate_block_hash(str(data.get("hash", "")))
+                await backend.cancel(block_hash)
+                return web.json_response({})
+            if action == "work_validate":
+                block_hash = nc.validate_block_hash(str(data.get("hash", "")))
+                work = nc.validate_work_hex(str(data.get("work", "")))
+                difficulty = int(
+                    nc.validate_difficulty(
+                        str(data.get("difficulty", f"{nc.BASE_DIFFICULTY:016x}"))
+                    ),
+                    16,
+                )
+                # Only insufficient work is "0"; malformed fields error out
+                # above like every other action.
+                valid = "1" if nc.work_value(block_hash, work) >= difficulty else "0"
+                return web.json_response({"valid": valid})
+            return web.json_response({"error": f"Unknown action: {action!r}"})
+        except WorkCancelled:
+            return web.json_response({"error": "Cancelled"})
+        except ValueError as e:  # includes every nc.Invalid* subclass
+            return web.json_response({"error": str(e)})
+        except WorkError as e:
+            return web.json_response({"error": str(e)})
+        except Exception:
+            logger.exception("work server internal error")
+            return web.json_response({"error": "Internal error"})
+
+    app = web.Application()
+    app.router.add_post("/", handler)
+    return app
+
+
+class WorkServer:
+    """Embeddable runner: serve a backend on host:port until stopped."""
+
+    def __init__(self, backend: WorkBackend, host: str = "127.0.0.1", port: int = 7000):
+        self.backend = backend
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> None:
+        await self.backend.setup()
+        self._runner = web.AppRunner(build_app(self.backend))
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for _host, port in self._runner.addresses:  # resolve port 0 → actual
+            self.port = port
+        logger.info("work server listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        await self.backend.close()
