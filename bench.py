@@ -5,9 +5,16 @@ Prints ONE JSON line:
 
 vs_baseline is measured against BASELINE.json's north-star target of
 1e9 Blake2b hashes/sec/chip (the reference itself publishes no numbers —
-SURVEY.md §6). Run with no args on the machine whose jax.devices()[0] is the
-chip under test; off-TPU it falls back to the XLA scanner with a small
-window so the harness still produces a (much slower) number.
+SURVEY.md §6).
+
+Robustness contract (round-1 postmortem): backend *initialization* can fail
+(UNAVAILABLE if a stale process still holds the chip — libtpu is
+single-client) or block outright on tunnel setup. Neither may cost the round
+its perf artifact, so the measurement runs in a bounded child process:
+up to 2 TPU attempts with a timeout and a retry pause, then a CPU-pinned
+fallback child, and if everything fails the parent still prints a JSON line
+(value 0 + error) and exits 0. The child also guarantees nothing keeps
+holding the TPU after the bench: it exits as soon as the number is printed.
 
 Extra diagnostics (geometry sweep, per-config latency runs) live in
 benchmarks/; this file stays minimal because the driver parses its stdout.
@@ -16,12 +23,17 @@ benchmarks/; this file stays minimal because the driver parses its stdout.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 TARGET_HS = 1e9  # BASELINE.json north_star: >= 1e9 H/s/chip on v5e
+
+ATTEMPT_TIMEOUT = 300  # s per child: TPU first-compile alone can be 20-40 s
+RETRY_PAUSE = 10  # s between TPU attempts (lets a stale chip holder die)
 
 
 def measure(reps: int = 8) -> dict:
@@ -78,7 +90,73 @@ def measure(reps: int = 8) -> dict:
     }
 
 
-if __name__ == "__main__":
-    result = measure()
+def _inproc(platform: str) -> int:
+    """Child-process mode: measure on the given platform, print JSON."""
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        # Env alone does not override a sitecustomize-registered accelerator
+        # backend; the config API does (same pinning as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(measure()))
+    return 0
+
+
+def _run_child(platform: str) -> dict | None:
+    """Run one bounded measurement child; return its parsed JSON or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inproc", platform],
+            capture_output=True,
+            text=True,
+            timeout=ATTEMPT_TIMEOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(out, dict) and "value" in out:
+            return out
+    return None
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--inproc":
+        return _inproc(sys.argv[2])
+
+    result = _run_child("tpu")
+    if result is None:
+        time.sleep(RETRY_PAUSE)
+        result = _run_child("tpu")
+    if result is not None and result.get("platform") == "cpu":
+        # JAX resolved to CPU on its own: the measurement is already a valid
+        # CPU number, just label it instead of re-measuring.
+        result["note"] = "tpu unavailable; cpu fallback"
+    elif result is None:
+        # TPU init failed/hung twice: labeled CPU-pinned fallback so the
+        # harness still records a number.
+        cpu = _run_child("cpu")
+        if cpu is not None:
+            cpu["note"] = "tpu unavailable; cpu fallback"
+            result = cpu
+    if result is None:
+        result = {
+            "metric": "blake2b_hash_throughput_per_chip",
+            "value": 0,
+            "unit": "H/s",
+            "vs_baseline": 0.0,
+            "error": "all measurement attempts failed or timed out",
+        }
     print(json.dumps(result))
-    sys.exit(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -250,3 +250,137 @@ def test_tcp_reconnect_replays_subscriptions():
         await pub.close(); await sub.close(); await server2.stop()
 
     run(main())
+
+
+# -- websocket face -----------------------------------------------------
+# Parity: the reference exposes MQTT-over-websockets on 9001 behind /mqtt/
+# for browser workers and dashboards (reference setup/mosquitto/dpow.conf:7-8,
+# setup/nginx/dpow:9-14); these pin the rebuild's equivalent.
+
+
+def test_ws_subscriber_sees_tcp_publish():
+    """A websocket subscriber (dashboard) receives what a TCP peer (server)
+    publishes — both faces route through the one broker."""
+    from tpu_dpow.transport.ws import WsBrokerServer, WsTransport
+
+    async def main():
+        broker = Broker(users=default_users())
+        tcp = TcpBrokerServer(broker, port=0)
+        ws = WsBrokerServer(broker, port=0)
+        await tcp.start()
+        await ws.start()
+        pub = TcpTransport(port=tcp.port, username="dpowserver", password="dpowserver")
+        sub = WsTransport(
+            url=f"ws://127.0.0.1:{ws.port}/mqtt",
+            username="dpowinterface", password="dpowinterface",
+        )
+        await pub.connect()
+        await sub.connect()
+        await sub.subscribe("statistics", qos=QOS_0)
+        await asyncio.sleep(0.05)
+        await pub.publish("statistics", '{"works": 1}', qos=QOS_0)
+        msgs = await _collect(sub, 1)
+        assert msgs[0].topic == "statistics"
+        assert msgs[0].payload == '{"works": 1}'
+        await pub.close(); await sub.close(); await ws.stop(); await tcp.stop()
+
+    run(main())
+
+
+def test_ws_qos1_ack_and_worker_roundtrip():
+    """A browser-style worker over websockets: hears work, publishes a QoS-1
+    result the TCP-attached server receives."""
+    from tpu_dpow.transport.ws import WsBrokerServer, WsTransport
+
+    async def main():
+        broker = Broker(users=default_users())
+        ws = WsBrokerServer(broker, port=0)
+        await ws.start()
+        srv = InProcTransport(broker, username="dpowserver", password="dpowserver")
+        worker = WsTransport(
+            url=f"ws://127.0.0.1:{ws.port}/mqtt/",  # trailing slash (nginx form)
+            username="client", password="client",
+        )
+        await srv.connect()
+        await worker.connect()
+        await srv.subscribe("result/#", qos=QOS_0)
+        await worker.subscribe("work/#", qos=QOS_0)
+        await asyncio.sleep(0.05)
+        await srv.publish("work/ondemand", "HASH,ffffffc000000000")
+        got = await _collect(worker, 1)
+        assert got[0].payload.startswith("HASH,")
+        await worker.publish("result/ondemand", "HASH,work,addr", qos=QOS_1)
+        res = await _collect(srv, 1)
+        assert res[0].topic == "result/ondemand"
+        await worker.close(); await srv.close(); await ws.stop()
+
+    run(main())
+
+
+def test_ws_auth_and_acl_enforced():
+    from tpu_dpow.transport.ws import WsBrokerServer, WsTransport
+
+    async def main():
+        broker = Broker(users=default_users())
+        ws = WsBrokerServer(broker, port=0)
+        await ws.start()
+        bad = WsTransport(
+            url=f"ws://127.0.0.1:{ws.port}/mqtt", username="client", password="nope",
+        )
+        with pytest.raises(AuthError):
+            await bad.connect()
+        await bad.close()
+        # dashboard user may not publish work
+        dash = WsTransport(
+            url=f"ws://127.0.0.1:{ws.port}/mqtt",
+            username="dpowinterface", password="dpowinterface",
+        )
+        await dash.connect()
+        await dash.publish("work/ondemand", "H,d", qos=QOS_0)  # silently denied
+        await asyncio.sleep(0.1)  # QoS-0 is fire-and-forget; let the face process
+        assert broker.stats["denied"] >= 1
+        await dash.close(); await ws.stop()
+
+    run(main())
+
+
+def test_ws_uri_parsing():
+    from tpu_dpow.transport.ws import WsTransport
+
+    t = WsTransport.from_uri("ws://client:secret@dpow.example.org:9001/mqtt")
+    assert t.url == "ws://dpow.example.org:9001/mqtt"
+    assert (t.username, t.password) == ("client", "secret")
+    t2 = WsTransport.from_uri("wss://u:p@host.example")
+    assert t2.url == "wss://host.example/mqtt"
+    with pytest.raises(Exception):
+        WsTransport.from_uri("tcp://nope")
+
+
+def test_second_connect_on_same_socket_rejected():
+    """Duplicate connect is a protocol error: exactly one broker session and
+    one pump per connection (regression guard for the FrameConn refactor)."""
+    import json as _json
+
+    async def main():
+        broker = Broker(users=default_users())
+        server = TcpBrokerServer(broker, port=0)
+        await server.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+
+        async def rpc(obj):
+            writer.write((_json.dumps(obj) + "\n").encode())
+            await writer.drain()
+            return _json.loads(await reader.readline())
+
+        first = await rpc({"op": "connect", "client_id": "dup", "username": "client",
+                           "password": "client"})
+        assert first["op"] == "connack"
+        second = await rpc({"op": "connect", "client_id": "dup2", "username": "client",
+                            "password": "client"})
+        assert second["op"] == "error"
+        assert (await reader.readline()) == b""  # connection closed
+        assert "dup2" not in broker.sessions  # no leaked session
+        writer.close()
+        await server.stop()
+
+    run(main())

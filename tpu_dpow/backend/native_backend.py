@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import hashlib
 import os
+import platform
 import secrets
 import subprocess
 import threading
@@ -41,43 +43,79 @@ _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _host_cpu_identity() -> str:
+    """A string that changes when the .so's -march=native output would:
+    CPU model + ISA feature flags (Linux), or the platform fallback."""
+    try:
+        model = flags = ""
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if not model and line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                elif not flags and line.startswith("flags"):
+                    flags = line.split(":", 1)[1].strip()
+                if model and flags:
+                    break
+        if model or flags:
+            return hashlib.sha256(f"{model}|{flags}".encode()).hexdigest()
+    except OSError:
+        pass
+    return f"{platform.machine()}|{platform.processor()}"
+
+
 def build_library(force: bool = False) -> str:
     """Compile native/blake2b_worker.cc → .so if missing/stale; return path.
 
     The compile lands in a temp file and is os.rename()d into place, so
     concurrent processes (server + client on one host, parallel pytest)
     never dlopen a half-written ELF. TPU_DPOW_NATIVE_DIR overrides the
-    output directory for read-only installs.
+    output directory for read-only installs; TPU_DPOW_NATIVE_MARCH overrides
+    the -march flag (default ``native`` — set e.g. ``x86-64-v2`` when the .so
+    lands on a shared volume for a heterogeneous fleet).
+
+    Staleness covers more than mtime: a sidecar .stamp records the compile
+    command and the host CPU identity, so a cached .so built with different
+    flags or on a different CPU (where -march=native bits could SIGILL this
+    process) is rebuilt instead of reused.
     """
     src = os.path.join(_NATIVE_DIR, "blake2b_worker.cc")
     out_dir = os.environ.get("TPU_DPOW_NATIVE_DIR", _NATIVE_DIR)
     out = os.path.join(out_dir, _LIB_NAME)
+    stamp_path = out + ".stamp"
     if not os.path.exists(src):
         raise WorkError(f"native source not found: {src}")
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3",
+        f"-march={os.environ.get('TPU_DPOW_NATIVE_MARCH', 'native')}",
+        "-funroll-loops",
+        "-fPIC",
+        "-std=c++17",
+        "-shared",
+        "-pthread",
+    ]
+    stamp = f"{' '.join(cmd)}|{_host_cpu_identity()}"
+    try:
+        with open(stamp_path) as f:
+            stamp_matches = f.read() == stamp
+    except OSError:
+        stamp_matches = False
     stale = (
         force
         or not os.path.exists(out)
         or os.path.getmtime(out) < os.path.getmtime(src)
+        or not stamp_matches
     )
     if stale:
         os.makedirs(out_dir, exist_ok=True)
         tmp = os.path.join(out_dir, f".{_LIB_NAME}.{os.getpid()}.tmp")
-        cmd = [
-            os.environ.get("CXX", "g++"),
-            "-O3",
-            "-march=native",
-            "-funroll-loops",
-            "-fPIC",
-            "-std=c++17",
-            "-shared",
-            "-pthread",
-            "-o",
-            tmp,
-            src,
-        ]
         try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            subprocess.run(
+                cmd + ["-o", tmp, src], check=True, capture_output=True, text=True
+            )
             os.rename(tmp, out)  # atomic: losers just overwrite with the same bits
+            with open(stamp_path, "w") as f:
+                f.write(stamp)
         except FileNotFoundError as e:
             raise WorkError(f"no C++ compiler available: {e}") from e
         except subprocess.CalledProcessError as e:

@@ -16,6 +16,9 @@ from .config import parse_args
 
 
 async def amain(argv=None) -> None:
+    from ..utils import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     config = parse_args(argv)
     get_logger("tpu_dpow.client", file_path=config.log_file)
     transport = TcpTransport.from_uri(

@@ -78,7 +78,10 @@ def _advance_base(params: jnp.ndarray, delta_lo: jnp.ndarray) -> jnp.ndarray:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "chunk_per_shard", "kernel", "sublanes", "iters", "interpret"),
+    static_argnames=(
+        "mesh", "chunk_per_shard", "kernel", "sublanes", "iters", "nblocks",
+        "group", "interpret",
+    ),
 )
 def sharded_search_chunk_batch(
     params_batch: jnp.ndarray,
@@ -88,6 +91,8 @@ def sharded_search_chunk_batch(
     kernel: str = "xla",
     sublanes: int = pallas_kernel.DEFAULT_SUBLANES,
     iters: int = pallas_kernel.DEFAULT_ITERS,
+    nblocks: int = 1,
+    group: int = 1,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """One ganged multi-chip launch: uint32[B,12] → uint32[B] global offsets.
@@ -99,17 +104,26 @@ def sharded_search_chunk_batch(
     single-chip engine.
 
     kernel='pallas' uses the hand-tiled TPU kernel per shard (then
-    chunk_per_shard must equal sublanes*128*iters); 'xla' uses the fused jnp
-    scanner (runs on any backend — this is what the CPU-mesh tests and the
-    driver's virtual-device dryrun exercise).
+    chunk_per_shard must equal sublanes*128*iters*nblocks); 'xla' uses the
+    fused jnp scanner (runs on any backend — this is what the CPU-mesh tests
+    and the driver's virtual-device dryrun exercise).
+
+    ``nblocks``/``group`` select the persistent-kernel mode per shard: each
+    chip scans ``nblocks`` consecutive windows in ONE dispatch with
+    per-request early exit between windows (ops/pallas_kernel.py
+    _kernel_blocks), so the multi-chip gang pays the ~8 ms dispatch floor
+    once per ``nblocks`` windows — the same amortization the single-chip
+    flagship mode uses, now per shard.
     """
     n_nonce = mesh.shape[NONCE_AXIS]
     if chunk_per_shard * n_nonce >= 1 << 31:
         # Global offsets must stay below the int32/SENTINEL range so the
         # pmin winner reduction and uint32 return contract both hold.
         raise ValueError("global chunk (chunk_per_shard * nonce shards) must be < 2^31")
-    if kernel == "pallas" and chunk_per_shard != sublanes * 128 * iters:
-        raise ValueError("pallas kernel: chunk_per_shard must equal sublanes*128*iters")
+    if kernel == "pallas" and chunk_per_shard != sublanes * 128 * iters * nblocks:
+        raise ValueError(
+            "pallas kernel: chunk_per_shard must equal sublanes*128*iters*nblocks"
+        )
 
     def shard_fn(p_local: jnp.ndarray) -> jnp.ndarray:
         idx = lax.axis_index(NONCE_AXIS).astype(jnp.uint32)
@@ -117,7 +131,8 @@ def sharded_search_chunk_batch(
         p_local = _advance_base(p_local, idx * span)
         if kernel == "pallas":
             local = pallas_kernel.pallas_search_chunk_batch(
-                p_local, sublanes=sublanes, iters=iters, interpret=interpret
+                p_local, sublanes=sublanes, iters=iters, nblocks=nblocks,
+                group=group, interpret=interpret,
             )
         else:
             local = search.search_chunk_batch(p_local, chunk_size=chunk_per_shard)
@@ -137,7 +152,11 @@ def sharded_search_chunk_batch(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "chunk_per_shard", "max_steps", "kernel")
+    jax.jit,
+    static_argnames=(
+        "mesh", "chunk_per_shard", "max_steps", "kernel", "sublanes", "iters",
+        "nblocks", "group", "interpret",
+    ),
 )
 def sharded_search_run(
     params_batch: jnp.ndarray,
@@ -146,6 +165,11 @@ def sharded_search_run(
     chunk_per_shard: int,
     max_steps: int,
     kernel: str = "xla",
+    sublanes: int = pallas_kernel.DEFAULT_SUBLANES,
+    iters: int = pallas_kernel.DEFAULT_ITERS,
+    nblocks: int = 1,
+    group: int = 1,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Device-resident multi-step search: keep ganged chunks flowing until
     every request has a hit or max_steps windows are dry.
@@ -164,7 +188,9 @@ def sharded_search_run(
     def step(state):
         k, params, lo, hi, done = state
         offs = sharded_search_chunk_batch(
-            params, mesh=mesh, chunk_per_shard=chunk_per_shard, kernel=kernel
+            params, mesh=mesh, chunk_per_shard=chunk_per_shard, kernel=kernel,
+            sublanes=sublanes, iters=iters, nblocks=nblocks, group=group,
+            interpret=interpret,
         )
         found = (offs != SENTINEL) & ~done
         base_lo = params[:, BASE_LO]

@@ -29,22 +29,45 @@ class LatencyProbe:
         self.result_deltas: list = []
         self.cancel_deltas: list = []
 
+    # Entries older than this can no longer produce a meaningful delta (the
+    # server's request timeout tops out at 30 s — reference
+    # server/dpow_server.py:330-336); prune them so a long-running probe on a
+    # busy broker doesn't grow work_sent without bound.
+    MAX_PENDING_AGE = 120.0
+
     async def run(self, duration: Optional[float] = None) -> None:
         await self.transport.connect()
         for pattern in ("work/#", "result/#", "cancel/#", "statistics"):
             await self.transport.subscribe(pattern, qos=QOS_0)
         deadline = None if duration is None else time.monotonic() + duration
-        async for msg in self.transport.messages():
-            self.on_message(msg.topic, msg.payload)
-            if deadline is not None and time.monotonic() >= deadline:
+        messages = self.transport.messages()
+        while True:
+            # Bound the wait so an idle broker still honors --duration.
+            timeout = None if deadline is None else deadline - time.monotonic()
+            if timeout is not None and timeout <= 0:
                 break
+            try:
+                msg = await asyncio.wait_for(anext(messages), timeout)
+            except (asyncio.TimeoutError, StopAsyncIteration):
+                break
+            self.on_message(msg.topic, msg.payload)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.MAX_PENDING_AGE
+        for block_hash, start in list(self.work_sent.items()):
+            if start < cutoff:
+                del self.work_sent[block_hash]
 
     def on_message(self, topic: str, payload: str) -> None:
         now = time.monotonic()
+        self._prune(now)
         if topic.startswith("work/"):
             block_hash = payload.split(",")[0]
             self.work_sent.setdefault(block_hash, now)
         elif topic.startswith("result/"):
+            # get, not pop: the cancel fan-out for this hash arrives after
+            # the winning result and still needs the start time; _prune is
+            # what keeps work_sent bounded.
             block_hash = payload.split(",")[0]
             start = self.work_sent.get(block_hash)
             if start is not None:

@@ -51,13 +51,27 @@ async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
         )
         if new_works < min_works:
             continue
-        payouts[addr] = {"works": new_works, "uuid": str(uuid.uuid4())}
-        if not dry_run:
-            await store.hset(
-                f"client:{addr}",
-                {f"snapshot_{f}": record.get(f, "0") for f in WORK_FIELDS},
-            )
+        # Deterministic uuid keyed on the exact counter state being
+        # snapshotted: a rerun over unchanged counters re-derives the SAME
+        # uuid, and that uuid is the node's idempotent send id downstream
+        # (reference payouts.py:95) — so even if an operator pays from both
+        # a crashed run's file and its rerun, nobody is paid twice.
+        state = ":".join(
+            f"{record.get(f, 0)}/{record.get(f'snapshot_{f}', 0)}" for f in WORK_FIELDS
+        )
+        payouts[addr] = {
+            "works": new_works,
+            "uuid": str(uuid.uuid5(uuid.NAMESPACE_URL, f"tpu-dpow:{addr}:{state}")),
+        }
 
+    # Durability order matters (this is money-adjacent): persist the payout
+    # record BEFORE advancing any snapshot_* counter, so a crash between the
+    # two at worst re-derives the same payouts on rerun (same uuids — see
+    # above) instead of silently losing credited works the way the
+    # reference's advance-then-write order can (client_snapshot.py:54-62).
+    # A crash in the middle of the counter loop below still shrinks the
+    # rerun's file, but the already-written file plus idempotent uuids keep
+    # every credited work payable exactly once.
     payouts_path = f"{out_dir}/payouts_{ts}.json"
     snapshot_path = f"{out_dir}/snapshot_{ts}.json"
     if not dry_run:
@@ -65,6 +79,11 @@ async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
             json.dump(payouts, f, indent=2)
         with open(snapshot_path, "w") as f:
             json.dump(snap, f, indent=2)
+        for addr in payouts:
+            await store.hset(
+                f"client:{addr}",
+                {f"snapshot_{f}": snap[addr].get(f, "0") for f in WORK_FIELDS},
+            )
     return {
         "clients_eligible": len(payouts),
         "total_works": sum(p["works"] for p in payouts.values()),

@@ -22,6 +22,9 @@ from .nano_ws import NanoWebsocketClient
 
 
 async def amain(argv=None) -> None:
+    from ..utils import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     config = parse_args(argv)
     logger = get_logger("tpu_dpow.server", file_path=config.log_file, debug=config.debug)
 

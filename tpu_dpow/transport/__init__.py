@@ -116,13 +116,13 @@ def default_users(server_password: str = "dpowserver", client_password: str = "c
     return {
         "dpowserver": User(
             password=server_password,
-            acl_pub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#", "priority/#"),
-            acl_sub=("result/#", "get_info/#"),
+            acl_pub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#"),
+            acl_sub=("result/#",),
         ),
         "client": User(
             password=client_password,
-            acl_pub=("result/#", "get_info/#"),
-            acl_sub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#", "priority/#"),
+            acl_pub=("result/#",),
+            acl_sub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#"),
         ),
         "dpowinterface": User(
             password="dpowinterface",

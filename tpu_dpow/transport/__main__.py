@@ -50,6 +50,14 @@ async def amain(argv=None) -> None:
     p = argparse.ArgumentParser("tpu-dpow broker")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=1883)
+    p.add_argument(
+        "--ws_port",
+        type=int,
+        default=None,
+        help="also serve the websocket face on this port (browser workers / "
+        "dashboards; reference mosquitto websockets listener 9001)",
+    )
+    p.add_argument("--ws_path", default="/mqtt", help="websocket endpoint path")
     p.add_argument("--users", default=None, help="path to users JSON")
     p.add_argument("--verbose", action="store_true")
     ns = p.parse_args(argv)
@@ -59,6 +67,14 @@ async def amain(argv=None) -> None:
     broker = Broker(users=users)
     server = TcpBrokerServer(broker, host=ns.host, port=ns.port)
     await server.start()
+    ws_server = None
+    if ns.ws_port is not None:
+        from .ws import WsBrokerServer
+
+        ws_server = WsBrokerServer(
+            broker, host=ns.host, port=ns.ws_port, path=ns.ws_path
+        )
+        await ws_server.start()
     logging.getLogger(__name__).info(
         "broker listening on %s:%d (%d users)", ns.host, ns.port, len(users)
     )
@@ -67,6 +83,8 @@ async def amain(argv=None) -> None:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if ws_server is not None:
+            await ws_server.stop()
         await server.stop()
 
 

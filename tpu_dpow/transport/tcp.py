@@ -35,6 +35,7 @@ from typing import AsyncIterator, Dict, Optional
 
 from . import AuthError, Message, QOS_0, QOS_1, Transport, TransportError, User
 from .broker import Broker, Session
+from .frames import FrameConn
 
 logger = logging.getLogger(__name__)
 
@@ -69,7 +70,7 @@ class TcpBrokerServer:
             self._server = None
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        session: Optional[Session] = None
+        conn = FrameConn(self.broker, "tcp")
         sender: Optional[asyncio.Task] = None
         self._conns.add(writer)
 
@@ -86,61 +87,23 @@ class TcpBrokerServer:
                     break
                 try:
                     frame = json.loads(line)
-                    op = frame["op"]
                 except Exception:
                     send({"op": "error", "reason": "bad frame"})
+                    await writer.drain()
                     continue
-                if op == "connect":
-                    try:
-                        session = self.broker.attach(
-                            str(frame.get("client_id") or f"tcp-{next(_ids)}"),
-                            str(frame.get("username", "")),
-                            str(frame.get("password", "")),
-                            bool(frame.get("clean_session", True)),
-                        )
-                    except AuthError as e:
-                        send({"op": "error", "reason": str(e)})
-                        await writer.drain()
-                        break
-                    send({"op": "connack"})
-                    sender = asyncio.ensure_future(self._pump(session, writer))
-                elif session is None:
-                    send({"op": "error", "reason": "not connected"})
-                elif op == "sub":
-                    try:
-                        self.broker.subscribe(
-                            session, str(frame["pattern"]), int(frame.get("qos", 0))
-                        )
-                        send({"op": "suback", "pattern": frame["pattern"]})
-                    except AuthError as e:
-                        send({"op": "error", "reason": str(e)})
-                elif op == "unsub":
-                    self.broker.unsubscribe(session, str(frame["pattern"]))
-                elif op == "pub":
-                    try:
-                        self.broker.publish(
-                            session,
-                            str(frame["topic"]),
-                            str(frame["payload"]),
-                            int(frame.get("qos", 0)),
-                        )
-                        if frame.get("mid") is not None:
-                            send({"op": "puback", "mid": frame["mid"]})
-                    except AuthError as e:
-                        send({"op": "error", "reason": str(e)})
-                elif op == "ping":
-                    send({"op": "pong"})
-                else:
-                    send({"op": "error", "reason": f"unknown op {op!r}"})
+                keep = conn.handle(frame, send)
                 await writer.drain()
+                if not keep:
+                    break
+                if conn.session is not None and sender is None:
+                    sender = asyncio.ensure_future(self._pump(conn.session, writer))
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             self._conns.discard(writer)
             if sender is not None:
                 sender.cancel()
-            if session is not None:
-                self.broker.detach(session)
+            conn.detach()
             writer.close()
             try:
                 await writer.wait_closed()
@@ -241,8 +204,12 @@ class TcpTransport(Transport):
                 delay = min(delay * 2, self.reconnect_max_interval)
         raise TransportError(f"could not reach broker at {self.host}:{self.port}: {last_error}")
 
-    async def _connect_once(self) -> None:
+    async def _open(self) -> None:
+        """Open the raw connection (overridden by the websocket client)."""
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+
+    async def _connect_once(self) -> None:
+        await self._open()
         await self._send(
             {
                 "op": "connect",

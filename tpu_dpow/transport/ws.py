@@ -1,0 +1,180 @@
+"""Websocket face for the broker: browser workers and dashboards.
+
+Parity with the reference's MQTT-over-websockets listener on port 9001
+(reference server/setup/mosquitto/dpow.conf:7-8) proxied at ``/mqtt/`` by
+nginx (reference server/setup/nginx/dpow:9-14), which is what its live MQTT
+dashboard rides on (reference server/README.md:133-135). The rebuild speaks
+the same JSON frames as the TCP face (contract in transport/tcp.py), one
+JSON object per websocket text message — a browser joins the swarm with the
+stock ``WebSocket`` API and ``JSON.stringify``, no MQTT library needed:
+
+    const ws = new WebSocket("wss://host/mqtt/");
+    ws.onopen = () => {
+      ws.send(JSON.stringify({op: "connect", username: "dpowinterface",
+                              password: "..."}));
+      ws.send(JSON.stringify({op: "sub", pattern: "statistics"}));
+    };
+    ws.onmessage = (e) => console.log(JSON.parse(e.data));
+
+Server face: ``WsBrokerServer`` (aiohttp). Client endpoint: ``WsTransport``,
+the TCP client with the stream swapped for a websocket — reconnect/backoff,
+subscription replay, and QoS-1 puback tracking are inherited unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from aiohttp import ClientSession, WSMsgType, web
+
+from . import TransportError
+from .broker import Broker, Session
+from .frames import FrameConn
+from .tcp import TcpTransport
+
+logger = logging.getLogger(__name__)
+
+
+class WsBrokerServer:
+    """Serves a Broker over websockets (aiohttp)."""
+
+    def __init__(
+        self,
+        broker: Broker,
+        host: str = "127.0.0.1",
+        port: int = 9001,
+        path: str = "/mqtt",
+    ):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self.path = path.rstrip("/") or "/mqtt"
+        self._runner: Optional[web.AppRunner] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        app = web.Application()
+        # Accept both /mqtt and /mqtt/ — nginx location blocks commonly
+        # forward the trailing-slash form (reference setup/nginx/dpow:9).
+        app.router.add_get(self.path, self._handle)
+        app.router.add_get(self.path + "/", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for server in (site._server,):  # resolve port 0 → actual
+            if server is not None and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+        logger.info("ws broker face on %s:%s%s", self.host, self.port, self.path)
+
+    async def stop(self) -> None:
+        for ws in list(self._conns):
+            await ws.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _handle(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        conn = FrameConn(self.broker, "ws")
+        pump: Optional[asyncio.Task] = None
+        out: list = []
+        self._conns.add(ws)
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    break
+                try:
+                    frame = json.loads(msg.data)
+                except Exception:
+                    await ws.send_json({"op": "error", "reason": "bad frame"})
+                    continue
+                keep = conn.handle(frame, out.append)
+                for reply in out:
+                    await ws.send_json(reply)
+                out.clear()
+                if not keep:
+                    break
+                if conn.session is not None and pump is None:
+                    pump = asyncio.ensure_future(self._pump(conn.session, ws))
+        except ConnectionError:
+            pass
+        finally:
+            self._conns.discard(ws)
+            if pump is not None:
+                pump.cancel()
+            conn.detach()
+            await ws.close()
+        return ws
+
+    async def _pump(self, session: Session, ws: web.WebSocketResponse) -> None:
+        try:
+            while session.queue is not None:
+                msg = await session.queue.get()
+                if msg is None:
+                    break
+                await ws.send_json(
+                    {"op": "msg", "topic": msg.topic, "payload": msg.payload, "qos": msg.qos}
+                )
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+
+class WsTransport(TcpTransport):
+    """Reconnecting websocket client endpoint (same protocol as TCP)."""
+
+    def __init__(self, url: str = "ws://127.0.0.1:9001/mqtt", **kwargs):
+        super().__init__(**kwargs)
+        self.url = url
+        self._http: Optional[ClientSession] = None
+        self._ws = None
+
+    @classmethod
+    def from_uri(cls, uri: str, **kwargs) -> "WsTransport":
+        """'ws://user:password@host:port/path' (wss:// for TLS)."""
+        from urllib.parse import urlparse, urlunparse
+
+        u = urlparse(uri)
+        if u.scheme not in ("ws", "wss"):
+            raise TransportError(f"unsupported websocket scheme {u.scheme!r}")
+        netloc = u.hostname or "127.0.0.1"
+        if u.port:
+            netloc += f":{u.port}"
+        url = urlunparse((u.scheme, netloc, u.path or "/mqtt", "", u.query, ""))
+        return cls(
+            url=url, username=u.username or "", password=u.password or "", **kwargs
+        )
+
+    async def _open(self) -> None:
+        if self._http is None or self._http.closed:
+            self._http = ClientSession()
+        self._ws = await self._http.ws_connect(self.url, heartbeat=30)
+
+    async def _send(self, obj: dict) -> None:
+        if self._ws is None or self._ws.closed:
+            raise TransportError("not connected")
+        await self._ws.send_json(obj)
+
+    async def _read_frame(self) -> Optional[dict]:
+        if self._ws is None:
+            return None
+        msg = await self._ws.receive()
+        if msg.type != WSMsgType.TEXT:
+            return None
+        return json.loads(msg.data)
+
+    def _drop_socket(self) -> None:
+        self._connected = False
+        ws, self._ws = self._ws, None
+        if ws is not None and not ws.closed:
+            asyncio.ensure_future(ws.close())
+
+    async def close(self) -> None:
+        await super().close()
+        if self._http is not None:
+            await self._http.close()
+            self._http = None

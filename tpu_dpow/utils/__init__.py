@@ -1,1 +1,21 @@
 from . import nanocrypto  # noqa: F401
+
+
+def honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS effective even when a site hook pre-registers an
+    accelerator backend.
+
+    Standard JAX honors the env var at backend resolution, but this
+    environment's accelerator plugin registers through sitecustomize and wins
+    over it — a worker pinned to ``JAX_PLATFORMS=cpu`` would still block on
+    accelerator tunnel setup. Routing the value through the config API (the
+    one override that always wins) restores the documented semantics.
+    Call before any jax.devices() — entrypoints do this at startup.
+    """
+    import os
+
+    value = os.environ.get("JAX_PLATFORMS")
+    if value:
+        import jax
+
+        jax.config.update("jax_platforms", value)

@@ -26,6 +26,10 @@ async def amain(argv=None) -> None:
     ns = p.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if ns.verbose else logging.INFO)
 
+    from ..utils import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
     host, _, port_str = ns.listen.rpartition(":")
     if not port_str.isdigit():
         p.error(f"--listen must be host:port, got {ns.listen!r}")
