@@ -1,0 +1,5 @@
+' tpu-dpow worker: run the Windows launcher hidden in the background
+' (parity: reference client/run_windows_background.vbs). Configure
+' run_windows.bat first.
+Set shell = CreateObject("Wscript.Shell")
+shell.Run "cmd /c run_windows.bat", 0, False

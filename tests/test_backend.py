@@ -195,3 +195,51 @@ def test_one_waiter_timeout_does_not_kill_dedup_waiters(backend):
         await backend.close()
 
     asyncio.run(run())
+
+
+# -- mesh-ganged mode ---------------------------------------------------
+# mesh_devices > 1 puts all N (virtual CPU) devices on every hash through
+# the (batch, nonce) mesh — the flagship multi-chip latency configuration
+# (SURVEY.md §7 stage 7).
+
+
+def test_mesh_backend_generates_valid_work():
+    async def run():
+        b = make_backend(mesh_devices=8)
+        assert b.chunk == 8 * b.chunk_per_shard  # ganged window
+        await b.setup()
+        h = random_hash()
+        work = await b.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_mesh_backend_concurrent_and_cancel():
+    async def run():
+        b = make_backend(mesh_devices=8)
+        await b.setup()
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(3)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        # cancel an unreachable-difficulty job mid-flight
+        hard = random_hash()
+        t = asyncio.ensure_future(b.generate(WorkRequest(hard, (1 << 64) - 2)))
+        await asyncio.sleep(0.2)
+        await b.cancel(hard)
+        with pytest.raises(WorkCancelled):
+            await t
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_mesh_backend_rejects_oversubscription():
+    import jax
+
+    from tpu_dpow.backend import WorkError
+
+    with pytest.raises(WorkError):
+        JaxWorkBackend(kernel="xla", mesh_devices=len(jax.devices()) + 1)

@@ -1,0 +1,58 @@
+"""Real-chip test env — the inverse of tests/conftest.py.
+
+tests/ pins JAX_PLATFORMS=cpu for fast, deterministic CPU runs; everything
+here runs on the actual TPU to guard the Mosaic lowering paths those tests
+cannot see (interpret mode is not Mosaic — a lowering bug in e.g. the int32
+min-reduction workaround or the SMEM multi-window found-flag would pass
+every CPU test and still ship invalid work).
+
+Chip availability is probed in a SUBPROCESS with a hard timeout: in this
+environment a bare jax.devices() can block for many minutes when the
+accelerator tunnel is down, which must surface as a clean skip, not a hung
+test session. Run: ``python -m pytest tests_tpu -q`` (no -m filter needed —
+everything here is tpu-marked).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+PROBE_TIMEOUT = float(os.environ.get("TPU_DPOW_TPU_PROBE_TIMEOUT", "120"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _probe_platform() -> str:
+    """Report the platform jax would resolve to, bounded by PROBE_TIMEOUT."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+        )
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    if proc.returncode != 0:
+        return "error"
+    return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "error"
+
+
+_platform = None
+
+
+def _tpu_available() -> bool:
+    global _platform
+    if _platform is None:
+        _platform = _probe_platform()
+    return _platform not in ("cpu", "timeout", "error")
+
+
+def pytest_collection_modifyitems(config, items):
+    if _tpu_available():
+        return
+    skip = pytest.mark.skip(reason=f"no TPU reachable (probe: {_platform})")
+    for item in items:
+        item.add_marker(skip)

@@ -51,6 +51,7 @@ class DpowClient:
                 kwargs["uri"] = config.worker_uri
             elif config.backend == "jax":
                 kwargs["max_batch"] = config.max_batch
+                kwargs["mesh_devices"] = config.mesh_devices
             backend = get_backend(config.backend, **kwargs)
         self.work_handler = WorkHandler(backend, self._send_result)
         self.last_heartbeat: Optional[float] = None

@@ -21,6 +21,7 @@ class ClientConfig:
     startup_heartbeat_wait: float = 2.0  # refuse to start without a live server
     reconnect_delay: float = 20.0
     max_batch: int = 16
+    mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
     log_file: Optional[str] = None
 
     def __post_init__(self):
@@ -44,6 +45,9 @@ def parse_args(argv=None) -> ClientConfig:
     p.add_argument("--worker_uri", default=c.worker_uri,
                    help="external work server (backend=subprocess)")
     p.add_argument("--max_batch", type=int, default=c.max_batch)
+    p.add_argument("--mesh_devices", type=int, default=c.mesh_devices,
+                   help="gang N local devices onto every hash (backend=jax; "
+                   "the multi-chip latency mode)")
     p.add_argument("--log_file", default=None)
     ns = p.parse_args(argv)
     return ClientConfig(**vars(ns))

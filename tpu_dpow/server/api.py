@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import datetime
 import json
+import os
 import traceback
 from typing import Optional
 
@@ -144,6 +145,12 @@ class ServerRunner:
             else:
                 site = web.TCPSite(runner, c.host, port)
             await site.start()
+            if unix_path:
+                # Group-writable so a reverse proxy running as a different
+                # user in the shared group (nginx ↔ server) can connect even
+                # when the deployment deviates from the shipped systemd unit
+                # (reference hardens this the same way, server/dpow/socket.py:7-30).
+                os.chmod(unix_path, 0o660)
             if not unix_path:
                 self.ports[name] = site._server.sockets[0].getsockname()[1]
             self._runners.append(runner)

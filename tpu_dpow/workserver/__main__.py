@@ -22,6 +22,8 @@ async def amain(argv=None) -> None:
     p.add_argument("--backend", default="jax", choices=["jax", "native"])
     p.add_argument("--threads", type=int, default=None,
                    help="native backend thread count")
+    p.add_argument("--mesh_devices", type=int, default=1,
+                   help="gang N local devices per hash (backend=jax)")
     p.add_argument("--verbose", action="store_true")
     ns = p.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if ns.verbose else logging.INFO)
@@ -34,6 +36,8 @@ async def amain(argv=None) -> None:
     if not port_str.isdigit():
         p.error(f"--listen must be host:port, got {ns.listen!r}")
     kwargs = {"threads": ns.threads} if ns.backend == "native" and ns.threads else {}
+    if ns.backend == "jax" and ns.mesh_devices > 1:
+        kwargs["mesh_devices"] = ns.mesh_devices
     server = WorkServer(
         get_backend(ns.backend, **kwargs), host or "127.0.0.1", int(port_str)
     )
