@@ -243,3 +243,122 @@ def test_mesh_backend_rejects_oversubscription():
 
     with pytest.raises(WorkError):
         JaxWorkBackend(kernel="xla", mesh_devices=len(jax.devices()) + 1)
+
+
+# -- device-resident run mode (run_steps > 1) -----------------------------
+# One launch covers up to run_steps windows in a lax.while_loop with early
+# exit (ops/runloop.py) — the TPU default that pays the dispatch round trip
+# once per run instead of once per window.
+
+
+def test_run_mode_generates_valid_work():
+    async def run():
+        b = make_backend(run_steps=16)
+        assert b._step_counts() == [1, 4, 16]
+        await b.setup()
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(4)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        assert b.total_solutions == 4
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_run_mode_adaptive_steps():
+    b = make_backend(run_steps=16)
+    # Easy difficulty solves inside one window -> no run-mode overshoot;
+    # near-unreachable difficulty asks for the full cap.
+    assert b._steps_for(EASY) == 1
+    assert b._steps_for((1 << 64) - 2) == 16
+    # The ladder never exceeds the configured cap.
+    b2 = make_backend(run_steps=4)
+    assert b2._step_counts() == [1, 4]
+    assert b2._steps_for((1 << 64) - 2) == 4
+
+
+def test_run_mode_cancel_between_runs():
+    async def run():
+        b = make_backend(run_steps=4)
+        await b.setup()
+        hard = random_hash()
+        t = asyncio.ensure_future(b.generate(WorkRequest(hard, (1 << 64) - 2)))
+        await asyncio.sleep(0.2)
+        await b.cancel(hard)
+        with pytest.raises(WorkCancelled):
+            await t
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_run_mode_mesh_generates_valid_work():
+    async def run():
+        b = make_backend(mesh_devices=8, run_steps=4)
+        await b.setup()
+        h = random_hash()
+        work = await b.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_run_mode_dedup_difficulty_raise_midflight():
+    """A dedup that raises the target while a run launch is in flight must
+    keep searching past a nonce that only satisfies the launched target."""
+
+    async def run():
+        b = make_backend(run_steps=4)
+        await b.setup()
+        h = random_hash()
+        t1 = asyncio.ensure_future(b.generate(WorkRequest(h, EASY)))
+        await asyncio.sleep(0)  # let the engine pick the job up
+        t2 = asyncio.ensure_future(b.generate(WorkRequest(h, 0xFFFF000000000000)))
+        w1, w2 = await asyncio.gather(t1, t2)
+        assert w1 == w2
+        nc.validate_work(h, w1, 0xFFFF000000000000)
+        await b.close()
+
+    asyncio.run(run())
+
+
+# -- launch-shape warming -------------------------------------------------
+# On TPU every distinct (batch, steps) shape is a separate multi-second
+# compile; with warm_shapes on, the engine only launches warmed shapes and
+# a background task grows the warm set after setup.
+
+
+def test_pick_shape_falls_back_to_warmed():
+    b = make_backend(run_steps=16, warm_shapes=True, max_batch=16)
+    b._warm = {(1, 1), (1, 4), (2, 1)}
+    assert b._pick_shape(1, 1) == (1, 1)
+    assert b._pick_shape(1, 16) == (1, 4)  # steps fall back down the ladder
+    assert b._pick_shape(2, 4) == (2, 1)  # (2,4) cold -> fewer steps
+    # batch 8 not warmed at all -> largest warmed batch carries the load
+    assert b._pick_shape(8, 1) == (2, 1)
+    b._warm.add((8, 1))
+    assert b._pick_shape(5, 1) == (8, 1)
+
+
+def test_warm_shapes_burst_completes_and_warm_set_grows():
+    async def run():
+        b = make_backend(warm_shapes=True, max_batch=8)
+        await b.setup()
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(6)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        if b._warm_task is not None:
+            await b._warm_task  # CPU compiles are cheap: let it finish
+        assert (8, 1) in b._warm
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_warm_shapes_off_is_transparent():
+    b = make_backend(warm_shapes=False, max_batch=16)
+    assert b._pick_shape(5, 4) == (8, 4)
+    assert b._pick_shape(30, 16) == (16, 16)

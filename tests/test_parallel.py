@@ -10,6 +10,7 @@ import hashlib
 import secrets
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -226,3 +227,28 @@ def test_global_chunk_cap_enforced(mesh):
             mesh=mesh,
             chunk_per_shard=1 << 30,
         )
+
+
+def test_sharded_run_active_mask_skips_padding(mesh):
+    """Padding rows (unreachable difficulty, active=False) must not hold the
+    device-resident while_loop at max_steps once real rows have solved."""
+    h = secrets.token_bytes(32)
+    rows = np.stack(
+        [
+            _params(h, 0xFFF0000000000000, 4321)[0],
+            _params(bytes(32), (1 << 64) - 1, 0)[0],  # engine batch padding
+        ]
+    )
+    lo, hi = sharded_search_run(
+        replicate_params(rows, mesh),
+        jnp.array([True, False]),
+        mesh=mesh,
+        chunk_per_shard=CHUNK,
+        max_steps=256,
+    )
+    lo, hi = np.asarray(lo), np.asarray(hi)
+    solved = (int(hi[0]) << 32) | int(lo[0])
+    assert solved != (1 << 64) - 1
+    work = search.work_hex_from_nonce(solved)
+    assert nc.work_value(h.hex(), work) >= 0xFFF0000000000000
+    assert int(lo[1]) == 0xFFFFFFFF and int(hi[1]) == 0xFFFFFFFF

@@ -131,3 +131,92 @@ def test_pallas_interpret_multiblock_sentinel_when_dry():
         )
     )
     assert got[0] == search.SENTINEL
+
+
+# -- device-resident run loop (ops/runloop.py) ---------------------------
+
+
+def test_run_batch_finds_nonce_across_windows():
+    from tpu_dpow.ops import runloop
+
+    h = RNG.bytes(32)
+    base = 7 << 20
+    window = 8 * 128 * 2  # sublanes=8, iters=2
+    # Plant the first solution several windows past the base.
+    planted = None
+    for off in range(6 * window):
+        if ref_value(base + off, h) >= EASY:
+            planted = off
+            break
+    assert planted is not None
+    difficulty = EASY
+    params = jnp.stack([jnp.asarray(search.pack_params(h, difficulty, base))])
+    lo, hi = runloop.search_run_batch(
+        params, jnp.array([True]), max_steps=8, kernel="xla",
+        sublanes=8, iters=2,
+    )
+    nonce = (int(hi[0]) << 32) | int(lo[0])
+    assert nonce == base + planted
+
+
+def test_run_batch_respects_max_steps():
+    from tpu_dpow.ops import runloop
+
+    h = RNG.bytes(32)
+    params = jnp.stack([jnp.asarray(search.pack_params(h, (1 << 64) - 1, 0))])
+    lo, hi = runloop.search_run_batch(
+        params, jnp.array([True]), max_steps=3, kernel="xla",
+        sublanes=8, iters=2,
+    )
+    assert int(lo[0]) == 0xFFFFFFFF and int(hi[0]) == 0xFFFFFFFF
+
+
+def test_run_batch_inactive_rows_do_not_hold_loop():
+    from tpu_dpow.ops import runloop
+
+    h = RNG.bytes(32)
+    rows = jnp.stack(
+        [
+            jnp.asarray(search.pack_params(h, EASY, 0)),
+            # padding row: unreachable difficulty, must not keep scanning
+            jnp.asarray(search.pack_params(bytes(32), (1 << 64) - 1, 0)),
+        ]
+    )
+    lo, hi = runloop.search_run_batch(
+        rows, jnp.array([True, False]), max_steps=64, kernel="xla",
+        sublanes=8, iters=2,
+    )
+    assert int(lo[0]) != 0xFFFFFFFF or int(hi[0]) != 0xFFFFFFFF
+    assert int(lo[1]) == 0xFFFFFFFF and int(hi[1]) == 0xFFFFFFFF
+
+
+def test_run_batch_base_carry_across_64bit_wrap():
+    from tpu_dpow.ops import runloop
+
+    h = RNG.bytes(32)
+    window = 8 * 128 * 2
+    # Base close to 2^64: the advance must wrap cleanly through zero.
+    base = (1 << 64) - window - 3
+    params = jnp.stack([jnp.asarray(search.pack_params(h, EASY, base))])
+    lo, hi = runloop.search_run_batch(
+        params, jnp.array([True]), max_steps=8, kernel="xla",
+        sublanes=8, iters=2,
+    )
+    nonce = (int(hi[0]) << 32) | int(lo[0])
+    assert ref_value(nonce, h) >= EASY
+
+
+def test_run_batch_pallas_interpret_matches_xla():
+    from tpu_dpow.ops import runloop
+
+    h = RNG.bytes(32)
+    params = jnp.stack([jnp.asarray(search.pack_params(h, EASY, 1234))])
+    lo_x, hi_x = runloop.search_run_batch(
+        params, jnp.array([True]), max_steps=4, kernel="xla",
+        sublanes=8, iters=2,
+    )
+    lo_p, hi_p = runloop.search_run_batch(
+        params, jnp.array([True]), max_steps=4, kernel="pallas",
+        sublanes=8, iters=2, interpret=True,
+    )
+    assert int(lo_x[0]) == int(lo_p[0]) and int(hi_x[0]) == int(hi_p[0])

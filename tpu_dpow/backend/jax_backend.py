@@ -20,6 +20,16 @@ in-process engine built on the chunk scanners in ops/:
   * Chunked launches bound cancel latency and let the host check for
     cancels between steps (a SIMD machine cannot break mid-launch; SURVEY.md
     §7 hard part #2).
+  * Run mode (``run_steps`` > 1, the TPU default) widens a launch to up to
+    ``run_steps`` consecutive windows inside ONE persistent-kernel grid
+    dispatch (ops/pallas_kernel.py ``_kernel_blocks``): the grid's found
+    flag skips every window after a hit, so an easy request costs one
+    window while a hard one gets its whole median solve covered without
+    paying the dispatch + transfer round trip per window. The width adapts
+    to the hardest active difficulty. (A ``lax.while_loop`` over dispatches
+    — ops/runloop.py — is equivalent on local hardware, but through a
+    remote-chip tunnel each loop iteration costs a full host round trip,
+    so the engine prefers one wide grid.)
 
 Every found nonce is re-validated on host against hashlib before being
 returned (the belt to the device's suspenders, mirroring the reference's
@@ -29,8 +39,9 @@ final nanolib.validate_work at server/dpow_server.py:363-368).
 from __future__ import annotations
 
 import asyncio
+import math
 import secrets
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional
 
 import jax
@@ -42,7 +53,6 @@ from ..ops import pallas_kernel, search
 from ..utils import nanocrypto as nc
 from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
 
-_UNREACHABLE = (1 << 64) - 1  # padding difficulty: P(hit) = 2^-64 per hash
 _MASK64 = (1 << 64) - 1
 
 
@@ -92,6 +102,8 @@ class JaxWorkBackend(WorkBackend):
         interpret: bool = False,
         device: Optional[jax.Device] = None,
         mesh_devices: int = 1,  # >1: gang this many devices per hash
+        run_steps: Optional[int] = None,  # cap on windows per device launch
+        warm_shapes: Optional[bool] = None,  # background-compile launch shapes
     ):
         if mesh_devices > 1:
             devices = jax.devices()
@@ -125,8 +137,28 @@ class JaxWorkBackend(WorkBackend):
             self.group = 1
         self.chunk_per_shard = self.sublanes * 128 * self.iters * self.nblocks
         self.chunk = self.chunk_per_shard * (mesh_devices if self.mesh else 1)
+        # Run mode: one launch may widen to run_steps consecutive windows in
+        # a single persistent-kernel grid dispatch with cross-window early
+        # exit. The cap bounds cancel latency: a launch cannot be
+        # interrupted, so worst case a cancel waits run_steps windows
+        # (16 * ~30 ms ≈ 0.5 s at the TPU default geometry). The window
+        # ladder also may not cross the kernel's 2^31-offset limit.
+        if run_steps is None:
+            run_steps = 16 if on_tpu else 1
+        max_by_window = max(1, ((1 << 31) - 1) // self.chunk)
+        self.run_steps = max(1, min(run_steps, max_by_window))
         self.max_batch = max_batch
         self.interpret = interpret
+        # Every distinct (batch, steps) launch shape is a separate XLA
+        # compile (tens of seconds through a remote-chip tunnel, and the
+        # persistent compilation cache does not engage there). With shape
+        # warming on — the TPU default — the engine only ever launches
+        # shapes from _warm, and a background task grows that set after
+        # setup, so no request stalls behind a compile wall. Off (the CPU
+        # default, where compiles are cheap), everything counts as warm.
+        self.warm_shapes = on_tpu if warm_shapes is None else warm_shapes
+        self._warm: set = set()
+        self._warm_task: Optional[asyncio.Task] = None
         self._jobs: Dict[str, _Job] = {}
         self._engine_task: Optional[asyncio.Task] = None
         self._wakeup = asyncio.Event()
@@ -141,9 +173,20 @@ class JaxWorkBackend(WorkBackend):
         # Self-test: the engine must find a planted easy solution. Also pays
         # the one-time jit compile cost off the event loop.
         probe = search.pack_params(bytes(32), 1, base=0)
-        out = await asyncio.to_thread(self._launch, np.stack([probe]))
-        if int(out[0]) != 0:
-            raise WorkError(f"backend self-test failed (offset {int(out[0])})")
+        lo, hi = await asyncio.to_thread(self._launch, np.stack([probe]), 1)
+        if int(lo[0]) != 0 or int(hi[0]) != 0:
+            raise WorkError(
+                f"backend self-test failed (nonce {int(hi[0]):08x}{int(lo[0]):08x})"
+            )
+        self._warm.add((1, 1))
+        if self.run_steps > 1:
+            # Warm the run-mode compiles too (one per quantized step count
+            # the engine can emit, so no request pays a compile wall).
+            for steps in self._step_counts()[1:]:
+                await asyncio.to_thread(self._launch, np.stack([probe]), steps)
+                self._warm.add((1, steps))
+        if self.warm_shapes and self.max_batch > 1 and self._warm_task is None:
+            self._warm_task = asyncio.ensure_future(self._warmup_loop())
 
     async def generate(self, request: WorkRequest) -> str:
         if self._closed:
@@ -185,6 +228,13 @@ class JaxWorkBackend(WorkBackend):
 
     async def close(self) -> None:
         self._closed = True
+        if self._warm_task is not None:
+            self._warm_task.cancel()
+            try:
+                await self._warm_task
+            except asyncio.CancelledError:
+                pass
+            self._warm_task = None
         for job in list(self._jobs.values()):
             if not job.future.done():
                 job.future.set_exception(WorkCancelled("backend closed"))
@@ -200,47 +250,166 @@ class JaxWorkBackend(WorkBackend):
         if self._engine_task is None or self._engine_task.done():
             self._engine_task = asyncio.ensure_future(self._engine_loop())
 
-    def _launch(self, params_batch: np.ndarray) -> np.ndarray:
-        """One blocking batched device step (called via to_thread)."""
+    def _batch_sizes(self) -> list:
+        """The padded batch sizes the engine may emit (ascending pow2s,
+        plus max_batch itself when it is not a power of two)."""
+        sizes, b = [], 1
+        while b < self.max_batch:
+            sizes.append(b)
+            b *= 2
+        sizes.append(self.max_batch)
+        return sizes
+
+    async def _warmup_loop(self) -> None:
+        """Background-compile the remaining (batch, steps) launch shapes.
+
+        Probe rows solve at offset 0, so device time is negligible — each
+        iteration's cost is the compile itself, after which the shape
+        becomes eligible for real launches.
+        """
+        probe = search.pack_params(bytes(32), 1, base=0)
+        try:
+            for b in self._batch_sizes()[1:]:
+                for steps in self._step_counts():
+                    if self._closed:
+                        return
+                    if (b, steps) in self._warm:
+                        continue
+                    await asyncio.to_thread(
+                        self._launch, np.stack([probe] * b), steps
+                    )
+                    self._warm.add((b, steps))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # A failed warm compile must neither kill close() nor go
+            # unnoticed: the engine keeps running on the shapes already
+            # warmed, just without the bigger ones.
+            from ..utils.logging import get_logger
+
+            get_logger("tpu_dpow.backend").warning(
+                "launch-shape warmup failed; engine stays on %d warmed shapes",
+                len(self._warm),
+                exc_info=True,
+            )
+
+    def _pick_shape(self, njobs: int, steps_want: int) -> tuple:
+        """Largest warmed launch shape covering the demand.
+
+        Falls back to fewer steps (more round trips) or a smaller batch
+        (jobs beyond it wait one engine pass) rather than stalling every
+        active request behind a cold compile.
+        """
+        b_want = 1
+        while b_want < min(njobs, self.max_batch):
+            b_want *= 2
+        b_want = min(b_want, self.max_batch)
+        if not self.warm_shapes or not self._warm:
+            # Warming off (CPU default) or nothing warmed yet (generate()
+            # without setup()): launch the wanted shape, compiling inline.
+            return b_want, steps_want
+        warmed_bs = sorted({b for b, _ in self._warm})
+        fitting = [b for b in warmed_bs if b >= b_want]
+        b = fitting[0] if fitting else warmed_bs[-1]
+        cands = [s for bb, s in self._warm if bb == b and s <= steps_want]
+        steps = max(cands) if cands else steps_want  # compile inline if cold
+        return b, steps
+
+    def _step_counts(self) -> list:
+        """The quantized run lengths the engine may emit (ascending).
+
+        Each distinct count is a separate compile of the run loop, so the
+        ladder is powers of four — few enough to warm at setup, granular
+        enough that easy difficulties return to the host (and thus to
+        fresh arrivals and cancels) after one or two windows.
+        """
+        counts, steps = [1], 1
+        while steps < self.run_steps:
+            steps = min(steps * 4, self.run_steps)
+            counts.append(steps)
+        return counts
+
+    def _steps_for(self, difficulty: int) -> int:
+        """Windows one launch should cover for this difficulty: enough that
+        the median solve finishes in a single round trip (2x the median
+        window count), clamped to the run_steps cancel-latency cap."""
+        p = (2**64 - difficulty) / 2**64
+        median = math.log(2) / max(p, 1e-30)
+        windows = 2 * median / self.chunk
+        for steps in self._step_counts():
+            if steps >= windows:
+                return steps
+        return self.run_steps
+
+    def _launch(self, params_batch: np.ndarray, steps: int) -> tuple:
+        """One blocking batched device launch (called via to_thread).
+
+        Returns (lo, hi) uint32[B] — absolute winning nonces per row,
+        all-ones where the scanned span held no solution (padding rows
+        short-circuit via difficulty 0; their results are discarded).
+        ``steps`` > 1 widens the
+        launch to ``steps`` consecutive windows in the same single dispatch
+        (bigger ``nblocks`` grid / chunk), so the whole span costs one
+        host↔device round trip and early-exits per request as soon as a
+        window hits.
+        """
+        nblocks = self.nblocks * steps
         if self.mesh is not None:
             from ..parallel import replicate_params, sharded_search_chunk_batch
 
-            out = sharded_search_chunk_batch(
-                replicate_params(params_batch, self.mesh),
-                mesh=self.mesh,
-                chunk_per_shard=self.chunk_per_shard,
-                kernel=self.kernel,
-                sublanes=self.sublanes,
-                iters=self.iters,
-                nblocks=self.nblocks,
-                group=self.group,
-                interpret=self.interpret,
+            offs = np.asarray(
+                sharded_search_chunk_batch(
+                    replicate_params(params_batch, self.mesh),
+                    mesh=self.mesh,
+                    chunk_per_shard=self.chunk_per_shard * steps,
+                    kernel=self.kernel,
+                    sublanes=self.sublanes,
+                    iters=self.iters,
+                    nblocks=nblocks,
+                    group=self.group,
+                    interpret=self.interpret,
+                )
             )
-            return np.asarray(out)
+            return self._offsets_to_nonces(params_batch, offs)
         pj = jnp.asarray(params_batch)
         if self.kernel == "pallas":
             out = pallas_kernel.pallas_search_chunk_batch(
                 pj,
                 sublanes=self.sublanes,
                 iters=self.iters,
-                nblocks=self.nblocks,
+                nblocks=nblocks,
                 group=self.group,
                 interpret=self.interpret,
             )
         else:
-            out = search.search_chunk_batch(pj, chunk_size=self.chunk)
-        return np.asarray(out)
+            out = search.search_chunk_batch(pj, chunk_size=self.chunk * steps)
+        return self._offsets_to_nonces(params_batch, np.asarray(out))
 
-    _PAD_ROW = None  # lazily built unreachable-difficulty padding row
+    @staticmethod
+    def _offsets_to_nonces(params_batch: np.ndarray, offs: np.ndarray) -> tuple:
+        """Single-window offsets → the run-mode (lo, hi) nonce contract."""
+        base_lo = params_batch[:, search.BASE_LO]
+        win_lo = (base_lo + offs).astype(np.uint32)  # uint32 wrap
+        carry = (win_lo < base_lo).astype(np.uint32)
+        win_hi = (params_batch[:, search.BASE_HI] + carry).astype(np.uint32)
+        unsolved = offs == search.SENTINEL
+        ones = np.uint32(0xFFFFFFFF)
+        return np.where(unsolved, ones, win_lo), np.where(unsolved, ones, win_hi)
 
-    def _pack(self, jobs: list) -> np.ndarray:
-        """Fixed-shape batch: active jobs + unreachable-difficulty padding."""
-        b = 1
-        while b < len(jobs):
-            b *= 2
-        b = min(max(b, 1), self.max_batch)
+    _PAD_ROW = None  # lazily built difficulty-0 padding row
+
+    def _pack(self, jobs: list, b: int) -> np.ndarray:
+        """Fixed-shape batch: active jobs + difficulty-0 padding.
+
+        Difficulty 0 makes a padding row "hit" at offset 0, so the
+        persistent-kernel grid's per-row found flag skips all its windows
+        and the in-window early exit fires after one tile group — an
+        unreachable-difficulty pad would instead scan the launch's whole
+        widened span every pass. Pad results are discarded by the engine
+        (only the first len(jobs) rows are read back).
+        """
         if JaxWorkBackend._PAD_ROW is None:
-            JaxWorkBackend._PAD_ROW = search.pack_params(bytes(32), _UNREACHABLE, 0)
+            JaxWorkBackend._PAD_ROW = search.pack_params(bytes(32), 0, 0)
         out = np.empty((b, search.PARAMS_LEN), dtype=np.uint32)
         for i in range(b):
             out[i] = jobs[i].params if i < len(jobs) else JaxWorkBackend._PAD_ROW
@@ -274,21 +443,34 @@ class JaxWorkBackend(WorkBackend):
             if not active:
                 await asyncio.sleep(0)  # cancelled stragglers gc'd next pass
                 continue
-            params = self._pack(active)
+            # Difficulty-adaptive run length: cover the hardest active
+            # request's median solve in one round trip, within the cap —
+            # then clamp both batch and steps to warmed launch shapes.
+            steps_want = max(self._steps_for(j.difficulty) for j in active)
+            b, steps = self._pick_shape(len(active), steps_want)
+            active = active[:b]
+            params = self._pack(active, b)
+            span = self.chunk * steps
             # Snapshot each job's target at launch: a concurrent dedup may
             # raise job.difficulty while this chunk is in flight.
             launched_difficulty = [j.difficulty for j in active]
-            offsets = await asyncio.to_thread(self._launch, params)
-            for job, launched, off in zip(active, launched_difficulty, offsets[: len(active)]):
-                off = int(off)
-                self.total_hashes += self.chunk if off == int(search.SENTINEL) else off + 1
-                job.hashes_done += self.chunk
-                if job.future.done():
-                    continue  # cancelled while the chunk was in flight: drop
-                if off == int(search.SENTINEL):
-                    job.set_base(job.base + self.chunk)
+            lo_arr, hi_arr = await asyncio.to_thread(self._launch, params, steps)
+            self._warm.add((params.shape[0], steps))  # organic warming
+            for job, launched, lo, hi in zip(
+                active, launched_difficulty, lo_arr[: len(active)], hi_arr[: len(active)]
+            ):
+                nonce = (int(hi) << 32) | int(lo)
+                if nonce == _MASK64:  # span exhausted without a hit
+                    self.total_hashes += span
+                    job.hashes_done += span
+                    if not job.future.done():
+                        job.set_base(job.base + span)
                     continue
-                nonce = search.nonce_from_offset(job.base, off)
+                scanned = ((nonce - job.base) & _MASK64) + 1
+                self.total_hashes += scanned
+                job.hashes_done += scanned
+                if job.future.done():
+                    continue  # cancelled while the launch was in flight: drop
                 work = search.work_hex_from_nonce(nonce)
                 value = nc.work_value(job.block_hash, work)
                 if value >= job.difficulty:

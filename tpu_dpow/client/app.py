@@ -52,6 +52,8 @@ class DpowClient:
             elif config.backend == "jax":
                 kwargs["max_batch"] = config.max_batch
                 kwargs["mesh_devices"] = config.mesh_devices
+                if config.run_steps > 0:
+                    kwargs["run_steps"] = config.run_steps
             backend = get_backend(config.backend, **kwargs)
         self.work_handler = WorkHandler(backend, self._send_result)
         self.last_heartbeat: Optional[float] = None

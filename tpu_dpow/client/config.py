@@ -22,9 +22,12 @@ class ClientConfig:
     reconnect_delay: float = 20.0
     max_batch: int = 16
     mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
+    run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
     log_file: Optional[str] = None
 
     def __post_init__(self):
+        if self.run_steps < 0:
+            raise ValueError("--run_steps must be >= 0 (0 = auto)")
         if self.payout_address:
             self.payout_address = self.payout_address.replace("xrb_", "nano_")
             nc.validate_account(self.payout_address)
@@ -48,6 +51,11 @@ def parse_args(argv=None) -> ClientConfig:
     p.add_argument("--mesh_devices", type=int, default=c.mesh_devices,
                    help="gang N local devices onto every hash (backend=jax; "
                    "the multi-chip latency mode)")
+    p.add_argument("--run_steps", type=int, default=c.run_steps,
+                   help="max windows per device launch (backend=jax; 0 = "
+                   "auto: device-resident runs on TPU, single windows "
+                   "elsewhere; higher = less dispatch overhead, coarser "
+                   "cancel latency)")
     p.add_argument("--log_file", default=None)
     ns = p.parse_args(argv)
     return ClientConfig(**vars(ns))

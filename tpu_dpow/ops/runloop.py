@@ -1,0 +1,162 @@
+"""Device-resident multi-window nonce search for one device.
+
+The chunked engine (backend/jax_backend.py) pays a host↔device round trip
+per window: upload the params batch, run one kernel dispatch, download the
+offsets. On local hardware that costs ~8 ms; through a remote-chip tunnel it
+measured ~16 ms of per-dispatch overhead plus two transfer RTTs — dominating
+the <50 ms p50 latency budget (SURVEY.md §7 hard part #3; the reference's
+analog of this overhead is its per-work-item HTTP POST dialogue with the
+native worker, reference client/work_handler.py:104-108).
+
+``search_run_batch`` keeps the whole search on device: a ``lax.while_loop``
+launches up to ``max_steps`` consecutive windows, advances every row's
+64-bit base between windows on device, and exits as soon as every *active*
+row has a hit. One launch therefore costs one round trip regardless of how
+many windows the solution needs, while ``max_steps`` bounds the launch so
+the host still gets control back to apply cancels (a SIMD machine cannot be
+interrupted mid-dispatch — SURVEY.md §7 hard part #2).
+
+This is the single-chip sibling of parallel/mesh_search.py's
+``sharded_search_run``; both share the window contract of ops/search.py.
+
+Platform note: on local TPU hardware the while_loop is device-resident and
+this is the cheapest way to cover an arbitrarily large span per round trip.
+Through a remote-chip tunnel, however, each while_loop iteration was
+measured to cost a full host round trip (~70 ms) — there the in-process
+engine instead widens a single persistent-kernel grid dispatch
+(backend/jax_backend.py run mode), which stays one round trip regardless of
+window count at the cost of a 2^31-nonce span ceiling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import pallas_kernel, search
+from .search import BASE_LO, BASE_HI, SENTINEL
+
+#: nonce value reported for unsolved rows (all-ones). A genuine solution at
+#: nonce 2^64-1 would be indistinguishable and re-searched — a 2^-64 event
+#: per window, accepted for a branch-free device contract.
+UNSOLVED = (1 << 64) - 1
+
+
+def run_loop_core(
+    params_batch: jnp.ndarray,
+    active: Optional[jnp.ndarray],
+    *,
+    launch,
+    window,
+    max_steps: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The shared multi-window while_loop: trace-time building block.
+
+    ``launch(params) -> offsets`` scans one window of ``window`` nonces per
+    row; this core advances bases between windows, records first hits, and
+    exits once every active row is done. Used by both the single-chip
+    :func:`search_run_batch` and the mesh-ganged
+    :func:`tpu_dpow.parallel.sharded_search_run` so the subtle parts —
+    found-masking, pinning solved rows at their winning nonce, zeroing
+    padding rows' difficulty — live in exactly one place.
+    """
+
+    def step(state):
+        k, params, lo, hi, done = state
+        offs = launch(params)
+        found = (offs != SENTINEL) & ~done
+        win_lo, win_hi = search.nonces_from_offsets(params, offs)
+        lo = jnp.where(found, win_lo, lo)
+        hi = jnp.where(found, win_hi, hi)
+        done = done | found
+        params = search.advance_base_batch(params, window)
+        # Pin solved rows at their winning nonce: every later window then
+        # hits at offset 0 and takes the in-kernel early exit after one
+        # tile group, instead of re-scanning a full window per step while
+        # a harder row keeps the loop alive.
+        params = params.at[:, BASE_LO].set(jnp.where(done, lo, params[:, BASE_LO]))
+        params = params.at[:, BASE_HI].set(jnp.where(done, hi, params[:, BASE_HI]))
+        return k + 1, params, lo, hi, done
+
+    def cond(state):
+        k, _, _, _, done = state
+        return (k < max_steps) & ~jnp.all(done)
+
+    b = params_batch.shape[0]
+    ones = jnp.full((b,), 0xFFFFFFFF, dtype=jnp.uint32)
+    pb = params_batch
+    if active is None:
+        done0 = jnp.zeros((b,), dtype=bool)
+    else:
+        done0 = ~active
+        # Inactive (padding) rows get difficulty 0: they "hit" at offset 0
+        # and early-exit each window at one tile group's cost; done0 keeps
+        # their result pinned at the all-ones unsolved marker.
+        zero = jnp.uint32(0)
+        pb = pb.at[:, search.DIFF_LO].set(
+            jnp.where(active, pb[:, search.DIFF_LO], zero)
+        )
+        pb = pb.at[:, search.DIFF_HI].set(
+            jnp.where(active, pb[:, search.DIFF_HI], zero)
+        )
+    init = (jnp.int32(0), pb, ones, ones, done0)
+    _, _, lo, hi, _ = lax.while_loop(cond, step, init)
+    return lo, hi
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_steps", "kernel", "sublanes", "iters", "nblocks", "group",
+        "interpret", "unroll",
+    ),
+)
+def search_run_batch(
+    params_batch: jnp.ndarray,
+    active: jnp.ndarray,
+    *,
+    max_steps: int,
+    kernel: str = "pallas",
+    sublanes: int = pallas_kernel.DEFAULT_SUBLANES,
+    iters: int = pallas_kernel.DEFAULT_ITERS,
+    nblocks: int = 1,
+    group: int = 1,
+    interpret: bool = False,
+    unroll: Optional[bool] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan up to ``max_steps`` windows per row in ONE device launch.
+
+    Args:
+      params_batch: uint32[B, 12] rows (ops/search.py layout).
+      active: bool[B] — False rows are batch padding: they are never
+        scanned-for and never keep the loop alive.
+      max_steps: windows per row before the host regains control.
+      kernel: 'pallas' (TPU tiles) or 'xla' (fused jnp scanner — the CPU
+        fallback/test path).
+
+    Returns:
+      (lo, hi) uint32[B] pairs — each row's absolute winning 64-bit nonce,
+      or all-ones (UNSOLVED) where ``max_steps`` windows came up dry. The
+      per-row window is ``sublanes * 128 * iters * nblocks`` nonces; rows
+      that solve early stop contributing compute via the in-kernel found
+      flag, and the loop exits once all active rows are done.
+    """
+    window = sublanes * 128 * iters * nblocks
+    if window >= 1 << 31:
+        raise ValueError("per-step window must stay below 2^31 nonces")
+
+    def launch(params: jnp.ndarray) -> jnp.ndarray:
+        if kernel == "pallas":
+            return pallas_kernel.pallas_search_chunk_batch(
+                params, sublanes=sublanes, iters=iters, nblocks=nblocks,
+                group=group, interpret=interpret, unroll=unroll,
+            )
+        return search.search_chunk_batch(params, chunk_size=window, unroll=unroll)
+
+    return run_loop_core(
+        params_batch, active, launch=launch, window=window, max_steps=max_steps
+    )

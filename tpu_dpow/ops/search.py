@@ -107,6 +107,36 @@ def search_chunk_batch(
     )(params_batch)
 
 
+def nonces_from_offsets(
+    params_batch: jnp.ndarray, offs: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Window offsets → absolute (lo, hi) 64-bit nonces, carry-correct.
+
+    Shared by the multi-step run loops (ops/runloop.py,
+    parallel/mesh_search.py); the engine keeps a numpy twin
+    (backend/jax_backend.py ``_offsets_to_nonces``) that additionally maps
+    the SENTINEL to the all-ones unsolved marker.
+    """
+    base_lo = params_batch[:, BASE_LO]
+    win_lo = base_lo + offs
+    win_hi = params_batch[:, BASE_HI] + (win_lo < base_lo).astype(jnp.uint32)
+    return win_lo, win_hi
+
+
+def advance_base_batch(params_batch: jnp.ndarray, delta_lo) -> jnp.ndarray:
+    """params[B,12] with every row's 64-bit base advanced by delta_lo (< 2^32).
+
+    Device-side equivalent of the host loop's ``job.set_base(base + chunk)``
+    — used by the multi-step run loops (ops/runloop.py,
+    parallel/mesh_search.py) to keep the whole window-advance on device.
+    """
+    old_lo = params_batch[:, BASE_LO]
+    new_lo = old_lo + jnp.uint32(delta_lo)
+    carry = (new_lo < old_lo).astype(jnp.uint32)
+    new_hi = params_batch[:, BASE_HI] + carry
+    return params_batch.at[:, BASE_LO].set(new_lo).at[:, BASE_HI].set(new_hi)
+
+
 def nonce_from_offset(base: int, offset: int) -> int:
     return (base + offset) & 0xFFFFFFFFFFFFFFFF
 

@@ -41,7 +41,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import pallas_kernel, search
+from ..ops import pallas_kernel, runloop, search
 from ..ops.search import BASE_LO, BASE_HI, PARAMS_LEN, SENTINEL
 
 BATCH_AXIS = "batch"
@@ -67,13 +67,7 @@ def make_mesh(
     return Mesh(arr, (BATCH_AXIS, NONCE_AXIS))
 
 
-def _advance_base(params: jnp.ndarray, delta_lo: jnp.ndarray) -> jnp.ndarray:
-    """params[B,12] with each row's 64-bit base advanced by delta_lo (< 2^32)."""
-    old_lo = params[:, BASE_LO]
-    new_lo = old_lo + delta_lo
-    carry = (new_lo < old_lo).astype(jnp.uint32)
-    new_hi = params[:, BASE_HI] + carry
-    return params.at[:, BASE_LO].set(new_lo).at[:, BASE_HI].set(new_hi)
+_advance_base = search.advance_base_batch
 
 
 @functools.partial(
@@ -160,6 +154,7 @@ def sharded_search_chunk_batch(
 )
 def sharded_search_run(
     params_batch: jnp.ndarray,
+    active: Optional[jnp.ndarray] = None,
     *,
     mesh: Mesh,
     chunk_per_shard: int,
@@ -181,37 +176,26 @@ def sharded_search_run(
     is how dispatch overhead is amortised toward the <50 ms p50 target
     (SURVEY.md §7 hard part #3). max_steps bounds the launch so the host can
     still interleave cancels between dispatches.
+
+    ``active`` (bool[B], optional) marks real rows: False rows are the
+    engine's fixed-shape batch padding — without the mask their unreachable
+    difficulty would hold the while_loop at ``max_steps`` every launch, even
+    when all real requests solved in the first window.
     """
     n_nonce = mesh.shape[NONCE_AXIS]
-    global_chunk = jnp.uint32(chunk_per_shard * n_nonce)
+    global_chunk = chunk_per_shard * n_nonce
 
-    def step(state):
-        k, params, lo, hi, done = state
-        offs = sharded_search_chunk_batch(
+    def launch(params: jnp.ndarray) -> jnp.ndarray:
+        return sharded_search_chunk_batch(
             params, mesh=mesh, chunk_per_shard=chunk_per_shard, kernel=kernel,
             sublanes=sublanes, iters=iters, nblocks=nblocks, group=group,
             interpret=interpret,
         )
-        found = (offs != SENTINEL) & ~done
-        base_lo = params[:, BASE_LO]
-        base_hi = params[:, BASE_HI]
-        win_lo = base_lo + offs
-        win_hi = base_hi + (win_lo < base_lo).astype(jnp.uint32)
-        lo = jnp.where(found, win_lo, lo)
-        hi = jnp.where(found, win_hi, hi)
-        done = done | found
-        params = _advance_base(params, global_chunk)
-        return k + 1, params, lo, hi, done
 
-    def cond(state):
-        k, _, _, _, done = state
-        return (k < max_steps) & ~jnp.all(done)
-
-    b = params_batch.shape[0]
-    ones = jnp.full((b,), 0xFFFFFFFF, dtype=jnp.uint32)
-    init = (jnp.int32(0), params_batch, ones, ones, jnp.zeros((b,), dtype=bool))
-    _, _, lo, hi, _ = lax.while_loop(cond, step, init)
-    return lo, hi
+    return runloop.run_loop_core(
+        params_batch, active, launch=launch, window=global_chunk,
+        max_steps=max_steps,
+    )
 
 
 def expected_steps(difficulty: int, *, chunk_per_shard: int, n_nonce: int) -> int:
