@@ -43,6 +43,8 @@ async def run(n: int, concurrency: int) -> None:
     base_difficulty = nc.BASE_DIFFICULTY if on_tpu else 0xFF00000000000000
 
     broker = Broker(users=default_users())
+    server_auth = {"username": "dpowserver", "password": "dpowserver"}
+    client_auth = {"username": "client", "password": "client"}
     config = ServerConfig(
         base_difficulty=base_difficulty,
         throttle=100000.0,
@@ -52,7 +54,9 @@ async def run(n: int, concurrency: int) -> None:
         service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
     )
     store = MemoryStore()
-    server = DpowServer(config, store, InProcTransport(broker, client_id="server"))
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server", **server_auth)
+    )
     runner = ServerRunner(server, config)
     await runner.start()
     await store.hset(
@@ -69,7 +73,7 @@ async def run(n: int, concurrency: int) -> None:
     )
     client = DpowClient(
         ClientConfig(payout_address=PAYOUT, startup_heartbeat_wait=3.0),
-        InProcTransport(broker, client_id="worker", clean_session=False),
+        InProcTransport(broker, client_id="worker", clean_session=False, **client_auth),
         backend=backend,
     )
     await client.setup()
