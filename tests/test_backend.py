@@ -362,3 +362,36 @@ def test_warm_shapes_off_is_transparent():
     b = make_backend(warm_shapes=False, max_batch=16)
     assert b._pick_shape(5, 4) == (8, 4)
     assert b._pick_shape(30, 16) == (16, 16)
+
+
+# -- launch timeout (hang protection) -------------------------------------
+
+
+def test_launch_timeout_fails_waiters_and_recovers():
+    """A wedged device launch must surface as WorkError (not a silent hang),
+    close() must still tear down cleanly, and a later generate must work."""
+    import time as _time
+
+    from tpu_dpow.backend import WorkError
+
+    async def run():
+        b = make_backend(launch_timeout=0.2)
+        await b.setup()
+        real_launch = b._launch
+        slow = {"on": True}
+
+        def wedged(params, steps):
+            if slow["on"]:
+                _time.sleep(1.0)  # longer than launch_timeout
+            return real_launch(params, steps)
+
+        b._launch = wedged
+        with pytest.raises(WorkError):
+            await b.generate(WorkRequest(random_hash(), EASY))
+        slow["on"] = False  # "tunnel" recovers
+        h = random_hash()
+        work = await b.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        await b.close()  # engine died once; teardown must not re-raise
+
+    asyncio.run(run())

@@ -104,6 +104,7 @@ class JaxWorkBackend(WorkBackend):
         mesh_devices: int = 1,  # >1: gang this many devices per hash
         run_steps: Optional[int] = None,  # cap on windows per device launch
         warm_shapes: Optional[bool] = None,  # background-compile launch shapes
+        launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
     ):
         if mesh_devices > 1:
             devices = jax.devices()
@@ -157,8 +158,22 @@ class JaxWorkBackend(WorkBackend):
         # setup, so no request stalls behind a compile wall. Off (the CPU
         # default, where compiles are cheap), everything counts as warm.
         self.warm_shapes = on_tpu if warm_shapes is None else warm_shapes
+        # A remote-chip tunnel can wedge a dispatch or compile indefinitely
+        # (observed in this environment); the reference's analog is its
+        # worker-unreachable startup probe (client/work_handler.py:50-55).
+        # A bounded launch turns a silent worker hang into a WorkError the
+        # server can time out and the operator can see. The stuck thread
+        # itself cannot be killed, but the engine restarts on next demand.
+        if launch_timeout is None:
+            launch_timeout = 300.0 if on_tpu else None
+        self.launch_timeout = launch_timeout
         self._warm: set = set()
         self._warm_task: Optional[asyncio.Task] = None
+        # Dedicated launch executor (2 workers: one engine launch + one warm
+        # compile may overlap). A timed-out launch leaks its blocked thread,
+        # so the executor is REPLACED on timeout rather than poisoning
+        # asyncio's shared to_thread pool until the pool starves.
+        self._executor = None
         self._jobs: Dict[str, _Job] = {}
         self._engine_task: Optional[asyncio.Task] = None
         self._wakeup = asyncio.Event()
@@ -173,7 +188,7 @@ class JaxWorkBackend(WorkBackend):
         # Self-test: the engine must find a planted easy solution. Also pays
         # the one-time jit compile cost off the event loop.
         probe = search.pack_params(bytes(32), 1, base=0)
-        lo, hi = await asyncio.to_thread(self._launch, np.stack([probe]), 1)
+        lo, hi = await self._timed_launch(np.stack([probe]), 1)
         if int(lo[0]) != 0 or int(hi[0]) != 0:
             raise WorkError(
                 f"backend self-test failed (nonce {int(hi[0]):08x}{int(lo[0]):08x})"
@@ -183,7 +198,7 @@ class JaxWorkBackend(WorkBackend):
             # Warm the run-mode compiles too (one per quantized step count
             # the engine can emit, so no request pays a compile wall).
             for steps in self._step_counts()[1:]:
-                await asyncio.to_thread(self._launch, np.stack([probe]), steps)
+                await self._timed_launch(np.stack([probe]), steps)
                 self._warm.add((1, steps))
         if self.warm_shapes and self.max_batch > 1 and self._warm_task is None:
             self._warm_task = asyncio.ensure_future(self._warmup_loop())
@@ -241,8 +256,16 @@ class JaxWorkBackend(WorkBackend):
         self._jobs.clear()
         self._wakeup.set()
         if self._engine_task is not None:
-            await self._engine_task
+            try:
+                await self._engine_task
+            except Exception:
+                # The engine already failed its waiters before dying; its
+                # exception must not break teardown too.
+                pass
             self._engine_task = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
 
     # -- engine -----------------------------------------------------------
 
@@ -251,8 +274,21 @@ class JaxWorkBackend(WorkBackend):
             self._engine_task = asyncio.ensure_future(self._engine_loop())
 
     def _batch_sizes(self) -> list:
-        """The padded batch sizes the engine may emit (ascending pow2s,
-        plus max_batch itself when it is not a power of two)."""
+        """The padded batch sizes the engine may emit (ascending).
+
+        With shape warming on (TPU) there are exactly TWO: singleton and
+        max_batch. Difficulty-0 padding rows are free on the Pallas path
+        (measured: an all-pads batch-16 launch costs the bare round-trip
+        floor), so intermediate sizes would only multiply the compile
+        count — through a remote tunnel each extra shape is ~30 s of warmup
+        during which the engine would fall back to singleton launches and
+        batching throughput would sit at 1/launch-time.
+
+        With warming off (CPU/xla path: no early exit, pads scan their full
+        window) the ladder is the classic powers of two, compiled on demand.
+        """
+        if self.warm_shapes:
+            return [1, self.max_batch] if self.max_batch > 1 else [1]
         sizes, b = [], 1
         while b < self.max_batch:
             sizes.append(b)
@@ -275,9 +311,7 @@ class JaxWorkBackend(WorkBackend):
                         return
                     if (b, steps) in self._warm:
                         continue
-                    await asyncio.to_thread(
-                        self._launch, np.stack([probe] * b), steps
-                    )
+                    await self._timed_launch(np.stack([probe] * b), steps)
                     self._warm.add((b, steps))
         except asyncio.CancelledError:
             raise
@@ -300,10 +334,8 @@ class JaxWorkBackend(WorkBackend):
         (jobs beyond it wait one engine pass) rather than stalling every
         active request behind a cold compile.
         """
-        b_want = 1
-        while b_want < min(njobs, self.max_batch):
-            b_want *= 2
-        b_want = min(b_want, self.max_batch)
+        want = min(max(njobs, 1), self.max_batch)
+        b_want = next(b for b in self._batch_sizes() if b >= want)
         if not self.warm_shapes or not self._warm:
             # Warming off (CPU default) or nothing warmed yet (generate()
             # without setup()): launch the wanted shape, compiling inline.
@@ -340,6 +372,30 @@ class JaxWorkBackend(WorkBackend):
             if steps >= windows:
                 return steps
         return self.run_steps
+
+    async def _timed_launch(self, params_batch: np.ndarray, steps: int) -> tuple:
+        """_launch off the event loop, bounded by launch_timeout."""
+        if self._executor is None:
+            import concurrent.futures
+
+            self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(self._executor, self._launch, params_batch, steps)
+        if self.launch_timeout is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, self.launch_timeout)
+        except asyncio.TimeoutError:
+            # The wedged thread cannot be killed; abandon the whole executor
+            # so later launches get fresh workers instead of queueing behind
+            # the stuck one.
+            self._executor.shutdown(wait=False)
+            self._executor = None
+            raise WorkError(
+                f"device launch exceeded {self.launch_timeout:.0f}s "
+                f"(batch={params_batch.shape[0]}, steps={steps}) — "
+                "tunnel or device hang"
+            )
 
     def _launch(self, params_batch: np.ndarray, steps: int) -> tuple:
         """One blocking batched device launch (called via to_thread).
@@ -454,7 +510,7 @@ class JaxWorkBackend(WorkBackend):
             # Snapshot each job's target at launch: a concurrent dedup may
             # raise job.difficulty while this chunk is in flight.
             launched_difficulty = [j.difficulty for j in active]
-            lo_arr, hi_arr = await asyncio.to_thread(self._launch, params, steps)
+            lo_arr, hi_arr = await self._timed_launch(params, steps)
             self._warm.add((params.shape[0], steps))  # organic warming
             for job, launched, lo, hi in zip(
                 active, launched_difficulty, lo_arr[: len(active)], hi_arr[: len(active)]

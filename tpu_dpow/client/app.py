@@ -55,7 +55,16 @@ class DpowClient:
                 if config.run_steps > 0:
                     kwargs["run_steps"] = config.run_steps
             backend = get_backend(config.backend, **kwargs)
-        self.work_handler = WorkHandler(backend, self._send_result)
+        # The handler's in-flight cap must exceed the engine's batch size or
+        # the batched launch can never fill (the queue would starve it at 8
+        # like the reference's one-at-a-time worker dialogue); 2x keeps the
+        # next pack full while results are being reported. Derive from the
+        # RESOLVED backend so an injected engine's batch size wins over the
+        # config default.
+        concurrency = config.work_concurrency or 2 * getattr(backend, "max_batch", 4)
+        self.work_handler = WorkHandler(
+            backend, self._send_result, concurrency=concurrency
+        )
         self.last_heartbeat: Optional[float] = None
         self._server_online = True
         self._tasks: list = []

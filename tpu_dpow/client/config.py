@@ -23,6 +23,7 @@ class ClientConfig:
     max_batch: int = 16
     mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
+    work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
     log_file: Optional[str] = None
 
     def __post_init__(self):
@@ -56,6 +57,9 @@ def parse_args(argv=None) -> ClientConfig:
                    "auto: device-resident runs on TPU, single windows "
                    "elsewhere; higher = less dispatch overhead, coarser "
                    "cancel latency)")
+    p.add_argument("--work_concurrency", type=int, default=c.work_concurrency,
+                   help="work items in flight at once (0 = auto: 2*max_batch "
+                   "for the jax backend, 8 otherwise)")
     p.add_argument("--log_file", default=None)
     ns = p.parse_args(argv)
     return ClientConfig(**vars(ns))
