@@ -16,6 +16,7 @@ import pytest
 
 from tpu_dpow.ops import search
 from tpu_dpow.parallel import (
+    BATCH_AXIS,
     NONCE_AXIS,
     expected_steps,
     make_mesh,
@@ -252,3 +253,67 @@ def test_sharded_run_active_mask_skips_padding(mesh):
     work = search.work_hex_from_nonce(solved)
     assert nc.work_value(h.hex(), work) >= 0xFFF0000000000000
     assert int(lo[1]) == 0xFFFFFFFF and int(hi[1]) == 0xFFFFFFFF
+
+
+# -- multi-host topology (parallel/multihost.py) --------------------------
+
+
+class _StubDev:
+    def __init__(self, id, process_index):
+        self.id = id
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"dev{self.id}@h{self.process_index}"
+
+
+def test_arrange_by_host_groups_ici_rows():
+    from tpu_dpow.parallel import arrange_by_host
+
+    devs = [
+        _StubDev(5, 1), _StubDev(0, 0), _StubDev(4, 1),
+        _StubDev(1, 0), _StubDev(2, 0), _StubDev(3, 1),
+    ]
+    arr = arrange_by_host(devs)
+    assert arr.shape == (2, 3)
+    # rows are hosts in order; columns sorted by device id within the host
+    assert [d.id for d in arr[0]] == [0, 1, 2]
+    assert [d.id for d in arr[1]] == [3, 4, 5]
+
+
+def test_arrange_by_host_rejects_ragged_slice():
+    from tpu_dpow.parallel import arrange_by_host
+
+    with pytest.raises(ValueError):
+        arrange_by_host([_StubDev(0, 0), _StubDev(1, 0), _StubDev(2, 1)])
+
+
+def test_multihost_mesh_single_process_runs_search():
+    """With one process the multihost mesh is (1, n_local) — and the ganged
+    search must run on it exactly as on make_mesh's latency mode."""
+    import jax
+
+    from tpu_dpow.parallel import make_multihost_mesh
+
+    mesh = make_multihost_mesh(jax.devices()[:4])
+    assert mesh.shape[BATCH_AXIS] == 1 and mesh.shape[NONCE_AXIS] == 4
+    h = secrets.token_bytes(32)
+    base = 77
+    planted = base + 2 * CHUNK + 9  # third shard's sub-range
+    diff = _plant_solution(h, planted)
+    p = _params(h, diff, base)
+    out = np.asarray(
+        sharded_search_chunk_batch(
+            replicate_params(p, mesh), mesh=mesh, chunk_per_shard=CHUNK
+        )
+    )
+    off = int(out[0])
+    assert off != 0xFFFFFFFF and off <= planted - base
+    assert nc.work_value(h.hex(), search.work_hex_from_nonce(base + off)) >= diff
+
+
+def test_init_distributed_noop_without_coordinator(monkeypatch):
+    from tpu_dpow.parallel import init_distributed
+
+    monkeypatch.delenv("TPU_DPOW_COORDINATOR", raising=False)
+    init_distributed()  # must not raise or touch jax.distributed

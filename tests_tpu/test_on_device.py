@@ -132,3 +132,83 @@ def test_backend_e2e_on_device():
         await b.close()
 
     asyncio.run(run())
+
+
+def test_widened_grid_deep_hit_on_device(tpu_device):
+    """Run-mode geometry: the UNIQUE solution in a widened dispatch's range
+    sits many windows deep; the grid must reach it and return exactly it
+    (a trivially-early random hit can't satisfy this — the difficulty is
+    the range's maximum work value, computed on host)."""
+    import jax.numpy as jnp
+
+    from tpu_dpow.ops import pallas_kernel, search
+
+    sub, it, nb = 8, 8, 48
+    window = sub * 128 * it
+    span = nb * window
+    base = secrets.randbits(64)
+    while True:
+        h = secrets.token_bytes(32)
+        values = [
+            _plant(h, (base + off) & ((1 << 64) - 1)) for off in range(span)
+        ]
+        argmax = int(np.argmax(values))
+        if argmax >= 8 * window:  # deep enough to prove cross-window travel
+            break
+    diff = values[argmax]  # unique hit in range, by construction
+    params = np.stack([search.pack_params(h, diff, base)])
+    out = pallas_kernel.pallas_search_chunk_batch(
+        jnp.asarray(params), sublanes=sub, iters=it, nblocks=nb, group=4
+    )
+    assert int(np.asarray(out)[0]) == argmax
+
+
+def test_difficulty_zero_pad_rows_cost_nothing_and_report_zero(tpu_device):
+    """Difficulty-0 rows (the engine's batch padding) must hit at offset 0 —
+    the padding contract the two-shape warm design relies on."""
+    import jax.numpy as jnp
+
+    from tpu_dpow.ops import pallas_kernel, search
+
+    pad = search.pack_params(bytes(32), 0, 0)
+    real_h = secrets.token_bytes(32)
+    real = search.pack_params(real_h, 0xFFF0000000000000, secrets.randbits(64))
+    params = np.stack([real] + [pad] * 7)
+    out = np.asarray(
+        pallas_kernel.pallas_search_chunk_batch(
+            jnp.asarray(params), sublanes=8, iters=16, nblocks=8, group=8
+        )
+    )
+    assert all(int(o) == 0 for o in out[1:])  # pads hit instantly
+
+
+def test_backend_run_mode_and_warm_shapes_on_device():
+    """The production defaults (widened runs + two-shape warming) through
+    generate(): singles and a batch burst, all hashlib-valid."""
+    import asyncio
+
+    from tpu_dpow.backend.jax_backend import JaxWorkBackend
+    from tpu_dpow.models import WorkRequest
+    from tpu_dpow.utils import nanocrypto as nc
+
+    async def run():
+        b = JaxWorkBackend(sublanes=8, iters=64, nblocks=2, max_batch=4)
+        assert b.run_steps > 1 and b.warm_shapes  # TPU defaults engaged
+        await b.setup()
+        easy = 0xFFF0000000000000
+        h = secrets.token_bytes(32).hex().upper()
+        work = await b.generate(WorkRequest(h, easy))
+        nc.validate_work(h, work, easy)
+        if b._warm_task is not None:
+            await b._warm_task  # small shapes: let warmup finish
+        reqs = [
+            WorkRequest(secrets.token_bytes(32).hex().upper(), easy)
+            for _ in range(4)
+        ]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, easy)
+        assert (4, 1) in b._warm
+        await b.close()
+
+    asyncio.run(run())

@@ -107,11 +107,15 @@ class JaxWorkBackend(WorkBackend):
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
     ):
         if mesh_devices > 1:
-            devices = jax.devices()
+            # local_devices: under a jax.distributed multi-host slice the
+            # per-worker gang must only claim this host's chips (ICI
+            # domain); cross-host scale is the broker swarm's job, or an
+            # SPMD deployment over parallel/multihost.py's mesh.
+            devices = jax.local_devices()
             if len(devices) < mesh_devices:
                 raise WorkError(
                     f"mesh_devices={mesh_devices} but only {len(devices)} "
-                    "devices visible"
+                    "local devices visible"
                 )
             from ..parallel import make_mesh
 
@@ -119,7 +123,7 @@ class JaxWorkBackend(WorkBackend):
             self.device = devices[0]
         else:
             self.mesh = None
-            self.device = device or jax.devices()[0]
+            self.device = device or jax.local_devices()[0]
         on_tpu = self.device.platform == "tpu"
         self.kernel = kernel or ("pallas" if on_tpu else "xla")
         # Defaults follow the v5e geometry sweep (benchmarks/throughput.py):

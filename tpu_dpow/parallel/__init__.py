@@ -10,3 +10,8 @@ from .mesh_search import (  # noqa: F401
     sharded_search_chunk_batch,
     sharded_search_run,
 )
+from .multihost import (  # noqa: F401
+    arrange_by_host,
+    init_distributed,
+    make_multihost_mesh,
+)

@@ -1,0 +1,102 @@
+"""Multi-host meshes: scale the nonce search past one host's chips.
+
+The reference scales past one machine by adding MQTT clients — every extra
+host is an independent racer, coordinated only by the broker and the Redis
+winner lock (reference README.md:21, server/dpow_server.py:138). The TPU
+rebuild keeps that swarm plane for *independent* workers, and adds the pod
+dimension the reference cannot express: one logical worker spanning a
+multi-host TPU slice via ``jax.distributed``.
+
+Topology rule (the "collectives ride ICI, not DCN" recipe): the
+``nonce`` axis — whose per-window ``pmin`` winner election runs every
+launch — must stay inside a host's ICI domain; the ``batch`` axis, which
+needs no per-launch communication at all (requests are independent), is the
+axis allowed to cross hosts over DCN. :func:`make_multihost_mesh` arranges
+the global device array exactly that way: ``batch`` = process (host) index,
+``nonce`` = that host's local chips. Each request is then ganged across ONE
+host's chips at ICI latency while the pod as a whole serves
+``process_count`` request streams — multi-host scaling at zero DCN cost on
+the hot path.
+
+For a single process this degrades to ``make_mesh`` over the local devices,
+so the same code path runs everywhere (tests use stub device objects; the
+driver's virtual-CPU dryrun uses the real thing with process_count == 1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .mesh_search import BATCH_AXIS, NONCE_AXIS, Mesh
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed.initialize with env-var fallbacks.
+
+    Env overrides (systemd-unit friendly, mirroring the reference's single
+    MQTT_SECRET_URI env pattern, reference server/dpow/config.py:27):
+    TPU_DPOW_COORDINATOR, TPU_DPOW_NUM_PROCESSES, TPU_DPOW_PROCESS_ID.
+    No-op when neither arguments nor env are present (single-host mode).
+    Honored at startup by the worker-client and workserver entrypoints
+    (tpu_dpow/client/__main__.py, tpu_dpow/workserver/__main__.py), whose
+    backends then gang jax.local_devices() — this host's ICI domain.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "TPU_DPOW_COORDINATOR"
+    )
+    if num_processes is None and "TPU_DPOW_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["TPU_DPOW_NUM_PROCESSES"])
+    if process_id is None and "TPU_DPOW_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TPU_DPOW_PROCESS_ID"])
+    if coordinator_address is None:
+        return  # single-host: nothing to initialize
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def arrange_by_host(devices: Sequence) -> np.ndarray:
+    """Global devices → (hosts, chips_per_host) array, ICI-contiguous rows.
+
+    Groups by ``device.process_index`` (host identity in JAX), sorts within
+    a host by device id for a stable ICI-neighbour order, and validates the
+    slice is rectangular (equal chips per host — true for any TPU pod
+    slice).
+    """
+    hosts: dict = {}
+    for d in devices:
+        hosts.setdefault(d.process_index, []).append(d)
+    counts = {len(v) for v in hosts.values()}
+    if len(counts) != 1:
+        raise ValueError(
+            f"uneven chips per host: { {k: len(v) for k, v in hosts.items()} }"
+        )
+    rows = [
+        sorted(hosts[p], key=lambda d: d.id) for p in sorted(hosts)
+    ]
+    return np.asarray(rows, dtype=object)
+
+
+def make_multihost_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """A (batch=hosts, nonce=local chips) mesh over a multi-host slice.
+
+    The nonce axis (per-launch pmin election) stays within each host's ICI
+    domain; the batch axis (no hot-path communication) is the one crossing
+    DCN. With one process this is simply (1, n_local) — the single-host
+    latency mode of :func:`~tpu_dpow.parallel.make_mesh`.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(arrange_by_host(devices), (BATCH_AXIS, NONCE_AXIS))
