@@ -218,3 +218,88 @@ def test_e2e_over_tcp_transport():
             await tcp_server.stop()
 
     run(main())
+
+
+def test_e2e_soak_with_cancels_and_timeouts():
+    """Chaos soak: a mixed stream of normal requests, client-timeout
+    aborts, and duplicate hashes racing, against two workers. Afterwards
+    the stack must be fully drained: no ongoing work, no leaked backend
+    jobs, and every normal request got valid work. (The reference can only
+    test this against a live swarm — SURVEY.md §4.)"""
+
+    async def main():
+        broker = Broker()
+        runner, server, store, clients = await start_stack(broker, n_clients=2)
+        try:
+            url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+            results = {"ok": 0, "timeout": 0, "error": 0}
+
+            async def normal(http, i):
+                h = random_hash()
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h}
+                ) as resp:
+                    body = await resp.json()
+                if "work" in body:
+                    nc.validate_work(h, body["work"], EASY_BASE)
+                    results["ok"] += 1
+                else:
+                    results["error"] += 1
+
+            async def duplicated(http, i):
+                # same hash from two "services" concurrently: dedup + shared
+                # result must serve both
+                h = random_hash()
+                async def one():
+                    async with http.post(
+                        url, json={"user": "svc", "api_key": "secret", "hash": h}
+                    ) as resp:
+                        return await resp.json()
+                a, b = await asyncio.gather(one(), one())
+                for body in (a, b):
+                    if "work" in body:
+                        nc.validate_work(h, body["work"], EASY_BASE)
+                        results["ok"] += 1
+                    else:
+                        results["error"] += 1
+
+            async def impatient(http, i):
+                # client walks away mid-request (connection abort path)
+                h = random_hash()
+                try:
+                    async with http.post(
+                        url,
+                        json={"user": "svc", "api_key": "secret", "hash": h},
+                        timeout=aiohttp.ClientTimeout(total=0.02),
+                    ) as resp:
+                        await resp.json()
+                except asyncio.TimeoutError:
+                    results["timeout"] += 1
+
+            async with aiohttp.ClientSession() as http:
+                tasks = []
+                for i in range(8):
+                    tasks.append(normal(http, i))
+                    if i % 2 == 0:
+                        tasks.append(duplicated(http, i))
+                    if i % 3 == 0:
+                        tasks.append(impatient(http, i))
+                await asyncio.gather(*tasks)
+
+            assert results["error"] == 0, results
+            assert results["ok"] == 8 + 2 * 4, results
+            # drain: give cancels/credits a beat, then nothing may linger
+            await asyncio.sleep(0.3)
+            for c in clients:
+                assert not c.work_handler.ongoing
+                backend = c.work_handler.backend
+                live = [
+                    j for j in getattr(backend, "_jobs", {}).values()
+                    if not j.future.done()
+                ]
+                assert not live
+            assert not server.work_futures
+        finally:
+            await stop_stack(runner, clients)
+
+    run(main())

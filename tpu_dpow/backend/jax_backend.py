@@ -79,7 +79,7 @@ class _Job:
 
 
 class JaxWorkBackend(WorkBackend):
-    """Batched chunked nonce search on whatever jax.devices() provides.
+    """Batched chunked nonce search on this host's jax.local_devices().
 
     ``mesh_devices`` > 1 gangs that many devices onto every hash through the
     (batch, nonce) mesh of parallel/mesh_search.py — the flagship latency

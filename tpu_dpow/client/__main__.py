@@ -8,7 +8,6 @@ feeds the TPU (or chosen backend) with the swarm's work.
 from __future__ import annotations
 
 import asyncio
-import os
 
 from ..transport.tcp import TcpTransport
 from ..utils.logging import get_logger
@@ -20,12 +19,9 @@ async def amain(argv=None) -> None:
     from ..utils import honor_jax_platforms_env
 
     honor_jax_platforms_env()
-    if os.environ.get("TPU_DPOW_COORDINATOR"):
-        # Multi-host slice: join the jax.distributed cluster before any
-        # backend touch so local_devices() reflects this host's chips.
-        from ..parallel import init_distributed
+    from ..parallel import maybe_init_distributed
 
-        init_distributed()
+    maybe_init_distributed()
     config = parse_args(argv)
     get_logger("tpu_dpow.client", file_path=config.log_file)
     transport = TcpTransport.from_uri(

@@ -31,14 +31,9 @@ async def amain(argv=None) -> None:
     from ..utils import honor_jax_platforms_env
 
     honor_jax_platforms_env()
-    import os
+    from ..parallel import maybe_init_distributed
 
-    if os.environ.get("TPU_DPOW_COORDINATOR"):
-        # Multi-host slice: join the jax.distributed cluster before any
-        # backend touch so local_devices() reflects this host's chips.
-        from ..parallel import init_distributed
-
-        init_distributed()
+    maybe_init_distributed()
 
     host, _, port_str = ns.listen.rpartition(":")
     if not port_str.isdigit():
