@@ -10,3 +10,21 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+
+async def wait_for_warmup(backend, timeout: float = 600.0) -> None:
+    """Block until the backend's launch-shape warm task finishes (if any).
+
+    Steady-state benchmarks call this after setup so batched launches run at
+    their real width instead of measuring XLA compile queueing; a wedged
+    warm compile (remote-tunnel hang) degrades to measuring anyway.
+    """
+    import asyncio
+
+    warm_task = getattr(backend, "_warm_task", None)
+    if warm_task is None:
+        return
+    try:
+        await asyncio.wait_for(asyncio.shield(warm_task), timeout=timeout)
+    except asyncio.TimeoutError:
+        print(f"# warmup still incomplete after {timeout:.0f}s; measuring anyway")

@@ -36,6 +36,7 @@ async def run(batch: int, difficulty: int) -> None:
     else:
         backend = JaxWorkBackend(max_batch=batch)
     await backend.setup()
+    await _bootstrap.wait_for_warmup(backend)
     hashes = [RNG.bytes(32).hex().upper() for _ in range(batch)]
     done_at: dict = {}
     t0 = time.perf_counter()

@@ -78,15 +78,7 @@ async def run(n: int, concurrency: int) -> None:
     )
     await client.setup()
     client.start_loops()
-    # Measure steady state: let the backend finish background-compiling its
-    # launch shapes (a worker reaches this within minutes of startup; cold
-    # numbers would mostly measure XLA compile queueing).
-    warm_task = getattr(backend, "_warm_task", None)
-    if warm_task is not None:
-        try:
-            await asyncio.wait_for(asyncio.shield(warm_task), timeout=360)
-        except asyncio.TimeoutError:
-            print("# warmup still incomplete after 360s; measuring anyway")
+    await _bootstrap.wait_for_warmup(backend, timeout=360)
 
     port = runner.ports["service"]
     url = f"http://127.0.0.1:{port}/service/"

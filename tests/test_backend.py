@@ -395,3 +395,53 @@ def test_launch_timeout_fails_waiters_and_recovers():
         await b.close()  # engine died once; teardown must not re-raise
 
     asyncio.run(run())
+
+
+# -- difficulty-rung scheduling -------------------------------------------
+
+
+def test_next_rung_round_robins():
+    b = make_backend(run_steps=16)
+    rungs = {1: ["e"], 4: ["m"], 16: ["h"]}
+    seq = [b._next_rung(rungs) for _ in range(6)]
+    assert seq == [1, 4, 16, 1, 4, 16]
+    # a rung disappearing mid-cycle doesn't wedge the cursor
+    assert b._next_rung({4: ["m"]}) == 4
+    assert b._next_rung({1: ["e"], 16: ["h"]}) == 16
+    assert b._next_rung({1: ["e"], 16: ["h"]}) == 1
+
+
+def test_mixed_difficulty_launches_split_by_rung():
+    """An unreachable-hard job must not widen the easy jobs' launches: the
+    engine alternates rung launches instead of one maximal pack."""
+
+    async def run():
+        b = make_backend(run_steps=16)
+        launches = []
+        orig = b._launch
+
+        def traced(params, steps):
+            launches.append((params.shape[0], steps))
+            return orig(params, steps)
+
+        b._launch = traced
+        await b.setup()
+        launches.clear()
+        hard = random_hash()
+        t_hard = asyncio.ensure_future(b.generate(WorkRequest(hard, (1 << 64) - 2)))
+        await asyncio.sleep(0)  # hard job enters the engine
+        works = await asyncio.gather(
+            *(b.generate(WorkRequest(random_hash(), EASY)) for _ in range(3))
+        )
+        assert len(works) == 3
+        await b.cancel(hard)
+        with pytest.raises(WorkCancelled):
+            await t_hard
+        # the easy jobs were served by steps-1 launches even while the
+        # hard (steps-16) job was active; both rungs got device time
+        steps_seen = {s for _, s in launches}
+        assert 1 in steps_seen and 16 in steps_seen
+        assert not any(bsize > 1 and steps == 16 for bsize, steps in launches), launches
+        await b.close()
+
+    asyncio.run(run())

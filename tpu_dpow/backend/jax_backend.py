@@ -25,8 +25,9 @@ in-process engine built on the chunk scanners in ops/:
     dispatch (ops/pallas_kernel.py ``_kernel_blocks``): the grid's found
     flag skips every window after a hit, so an easy request costs one
     window while a hard one gets its whole median solve covered without
-    paying the dispatch + transfer round trip per window. The width adapts
-    to the hardest active difficulty. (A ``lax.while_loop`` over dispatches
+    paying the dispatch + transfer round trip per window. Jobs are grouped
+    into difficulty rungs served round-robin, each launch as wide as its
+    own rung wants. (A ``lax.while_loop`` over dispatches
     — ops/runloop.py — is equivalent on local hardware, but through a
     remote-chip tunnel each loop iteration costs a full host round trip,
     so the engine prefers one wide grid.)
@@ -179,6 +180,7 @@ class JaxWorkBackend(WorkBackend):
         # asyncio's shared to_thread pool until the pool starves.
         self._executor = None
         self._jobs: Dict[str, _Job] = {}
+        self._last_rung = -1  # round-robin cursor over difficulty rungs
         self._engine_task: Optional[asyncio.Task] = None
         self._wakeup = asyncio.Event()
         self._closed = False
@@ -475,6 +477,21 @@ class JaxWorkBackend(WorkBackend):
             out[i] = jobs[i].params if i < len(jobs) else JaxWorkBackend._PAD_ROW
         return out
 
+    def _next_rung(self, rungs: Dict[int, list]) -> int:
+        """Next difficulty rung to serve, round-robin by run length.
+
+        Cycles through the present rung keys in ascending order starting
+        after the last one served, so mixed traffic alternates fairly
+        between e.g. steps-1 precache work and a steps-16 hard request.
+        """
+        keys = sorted(rungs)
+        for k in keys:
+            if k > self._last_rung:
+                self._last_rung = k
+                return k
+        self._last_rung = keys[0]
+        return keys[0]
+
     async def _engine_loop(self) -> None:
         try:
             await self._engine_loop_inner()
@@ -499,14 +516,21 @@ class JaxWorkBackend(WorkBackend):
                     if not self._jobs:
                         return
                 continue
-            active = [j for j in self._jobs.values() if not j.cancelled][: self.max_batch]
-            if not active:
+            alive = [j for j in self._jobs.values() if not j.cancelled]
+            if not alive:
                 await asyncio.sleep(0)  # cancelled stragglers gc'd next pass
                 continue
-            # Difficulty-adaptive run length: cover the hardest active
-            # request's median solve in one round trip, within the cap —
-            # then clamp both batch and steps to warmed launch shapes.
-            steps_want = max(self._steps_for(j.difficulty) for j in active)
+            # Difficulty-adaptive run length, decoupled across difficulty
+            # classes: jobs are grouped into rungs by the run length their
+            # difficulty wants, and each engine pass launches ONE rung
+            # (round-robin), so a hard request's wide launch never stretches
+            # every easy request's pass — and easy floods can't starve the
+            # hard rung either. Batch and steps then clamp to warmed shapes.
+            rungs: Dict[int, list] = {}
+            for j in alive:
+                rungs.setdefault(self._steps_for(j.difficulty), []).append(j)
+            steps_want = self._next_rung(rungs)
+            active = rungs[steps_want][: self.max_batch]
             b, steps = self._pick_shape(len(active), steps_want)
             active = active[:b]
             params = self._pack(active, b)

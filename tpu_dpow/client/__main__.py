@@ -19,7 +19,7 @@ async def amain(argv=None) -> None:
     from ..utils import honor_jax_platforms_env
 
     honor_jax_platforms_env()
-    from ..parallel import maybe_init_distributed
+    from ..utils import maybe_init_distributed
 
     maybe_init_distributed()
     config = parse_args(argv)

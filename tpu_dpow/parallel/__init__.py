@@ -14,5 +14,4 @@ from .multihost import (  # noqa: F401
     arrange_by_host,
     init_distributed,
     make_multihost_mesh,
-    maybe_init_distributed,
 )

@@ -66,17 +66,6 @@ def init_distributed(
     )
 
 
-def maybe_init_distributed() -> None:
-    """Entrypoint hook: join the slice iff TPU_DPOW_COORDINATOR is set.
-
-    The cheap env check runs before any jax import so single-host startups
-    pay nothing; both worker entrypoints (client and workserver) call this
-    before their first backend touch.
-    """
-    if os.environ.get("TPU_DPOW_COORDINATOR"):
-        init_distributed()
-
-
 def arrange_by_host(devices: Sequence) -> np.ndarray:
     """Global devices → (hosts, chips_per_host) array, ICI-contiguous rows.
 

@@ -19,3 +19,18 @@ def honor_jax_platforms_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", value)
+
+
+def maybe_init_distributed() -> None:
+    """Entrypoint hook: join a multi-host slice iff TPU_DPOW_COORDINATOR set.
+
+    Lives here (not in tpu_dpow.parallel) so the env check costs nothing on
+    single-host startups: importing the parallel package pulls in jax, and a
+    CPU/native worker should never pay that at process start.
+    """
+    import os
+
+    if os.environ.get("TPU_DPOW_COORDINATOR"):
+        from ..parallel import init_distributed
+
+        init_distributed()
