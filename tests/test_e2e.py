@@ -303,3 +303,62 @@ def test_e2e_soak_with_cancels_and_timeouts():
             await stop_stack(runner, clients)
 
     run(main())
+
+
+def test_e2e_over_mqtt_wire():
+    """Full flow with the server and worker speaking REAL MQTT 3.1.1 to the
+    broker (the reference's native protocol: its hbmqtt server/client and
+    Mosquitto would slot into exactly this wire, reference
+    server/dpow/mqtt.py, client/dpow_client.py)."""
+    from tpu_dpow.transport.mqtt import MqttTransport
+
+    async def main():
+        broker = Broker(users=default_users())
+        tcp_server = TcpBrokerServer(broker, port=0)
+        await tcp_server.start()
+        port = tcp_server.port
+
+        config = ServerConfig(
+            base_difficulty=EASY_BASE, throttle=1000.0,
+            heartbeat_interval=0.05, statistics_interval=3600.0,
+            service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store,
+            MqttTransport(port=port, username="dpowserver", password="dpowserver",
+                          client_id="server"),
+        )
+        runner = ServerRunner(server, config)
+        await runner.start()
+        await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                         "public": "N", "precache": "0",
+                                         "ondemand": "0"})
+        await store.sadd("services", "svc")
+
+        client = make_client(
+            MqttTransport(port=port, username="client", password="client",
+                          client_id="w-mqtt", clean_session=False),
+            PAYOUT_2,
+        )
+        await client.setup()
+        client.start_loops()
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                h = random_hash()
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h,
+                               "timeout": 20}
+                ) as resp:
+                    body = await resp.json()
+            assert "work" in body, body
+            nc.validate_work(h, body["work"], EASY_BASE)
+            credited = await store.hget(f"client:{PAYOUT_2}", "ondemand")
+            assert int(credited or 0) == 1
+        finally:
+            await client.close()
+            await runner.stop()
+            await tcp_server.stop()
+
+    run(main())

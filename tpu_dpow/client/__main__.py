@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import asyncio
 
-from ..transport.tcp import TcpTransport
+from ..transport import transport_from_uri
 from ..utils.logging import get_logger
 from .app import DpowClient
 from .config import parse_args
@@ -24,7 +24,7 @@ async def amain(argv=None) -> None:
     maybe_init_distributed()
     config = parse_args(argv)
     get_logger("tpu_dpow.client", file_path=config.log_file)
-    transport = TcpTransport.from_uri(
+    transport = transport_from_uri(
         config.server_uri,
         client_id=f"client-{config.payout_address[-8:]}",
         clean_session=False,

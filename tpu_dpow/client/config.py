@@ -39,7 +39,9 @@ class ClientConfig:
 def parse_args(argv=None) -> ClientConfig:
     c = ClientConfig()
     p = argparse.ArgumentParser("tpu-dpow client")
-    p.add_argument("--server", dest="server_uri", default=c.server_uri)
+    p.add_argument("--server", dest="server_uri", default=c.server_uri,
+                   help="broker URI: tcp:// (JSON-lines), mqtt:// (real MQTT "
+                   "3.1.1 — also works against a stock Mosquitto), or ws://")
     p.add_argument("--payout", dest="payout_address", required=True,
                    help="nano account receiving work credit")
     p.add_argument("--work", dest="work_type", default="any",

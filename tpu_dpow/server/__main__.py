@@ -10,10 +10,10 @@ from __future__ import annotations
 import asyncio
 
 from ..store import get_store
-from ..transport import default_users
+from ..transport import default_users, transport_from_uri
 from ..transport.broker import Broker
 from ..transport.inproc import InProcTransport
-from ..transport.tcp import TcpBrokerServer, TcpTransport
+from ..transport.tcp import TcpBrokerServer
 from ..utils.logging import get_logger
 from .api import ServerRunner
 from .app import DpowServer
@@ -41,7 +41,7 @@ async def amain(argv=None) -> None:
             broker, username="dpowserver", password="dpowserver", client_id="server"
         )
     else:
-        transport = TcpTransport.from_uri(config.transport_uri, client_id="server")
+        transport = transport_from_uri(config.transport_uri, client_id="server")
 
     store = get_store(config.store_uri)
     server = DpowServer(config, store, transport)

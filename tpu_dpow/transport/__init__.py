@@ -109,6 +109,32 @@ class User:
         )
 
 
+def transport_from_uri(uri: str, **kwargs) -> "Transport":
+    """Transport by URI scheme.
+
+    ``mqtt://`` → real MQTT 3.1.1 wire (this broker or a stock Mosquitto —
+    the reference's default client URI shape,
+    reference client/config_parse.py:16); ``tcp://``/``dpow://`` → the
+    JSON-lines protocol; ``ws://``/``wss://`` → websocket frames.
+    """
+    from urllib.parse import urlparse
+
+    scheme = urlparse(uri).scheme
+    if scheme == "mqtt":
+        from .mqtt import MqttTransport
+
+        return MqttTransport.from_uri(uri, **kwargs)
+    if scheme in ("tcp", "dpow"):
+        from .tcp import TcpTransport
+
+        return TcpTransport.from_uri(uri, **kwargs)
+    if scheme in ("ws", "wss"):
+        from .ws import WsTransport
+
+        return WsTransport.from_uri(uri, **kwargs)
+    raise TransportError(f"unsupported transport scheme {scheme!r}")
+
+
 # The reference's ACL matrix (server/setup/mosquitto/acls:1-33), transcribed:
 # the server writes work/cancel/heartbeat/statistics/client-stats and reads
 # results; clients the inverse; the dashboard user reads everything public.

@@ -1,0 +1,246 @@
+"""MQTT 3.1.1 faces of the broker: server handler + client transport.
+
+Server side: ``handle_mqtt_conn`` serves one MQTT connection against the
+shared Broker core (transport/broker.py) — the reference's Mosquitto seam
+(reference server/setup/mosquitto/dpow.conf, acls:1-33) becomes a protocol
+face of the same broker that already speaks JSON-lines and websockets, so
+stock paho/hbmqtt clients and dashboards connect unmodified. The TCP server
+(transport/tcp.py) sniffs the first byte of each connection and routes MQTT
+CONNECT (0x10) here, everything else to the JSON-lines handler: ONE port
+(1883) serves both, exactly where the reference ecosystem expects MQTT.
+
+Client side: ``MqttTransport`` speaks MQTT wire instead of JSON frames by
+overriding TcpTransport's frame layer only — reconnect/backoff, QoS-1 ack
+futures, subscription replay and the inbox all come from the parent. It
+connects equally to this broker or to a stock Mosquitto, which restores the
+reference's deployment option of an external C broker
+(SURVEY.md §2.4 item 2).
+
+Delivery semantics match the rest of the transport package: QoS 1 is
+at-least-once INTO the broker (PUBACK from the broker); onward delivery
+rides the broker's persistent session queues (clean_session=False +
+reconnect replay), not per-packet retransmit timers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Optional
+
+from . import AuthError, QOS_1, TransportError
+from .broker import Broker, Session
+from .tcp import TcpTransport
+from . import mqtt_codec as mc
+
+logger = logging.getLogger(__name__)
+
+_ids = itertools.count()
+
+
+async def handle_mqtt_conn(
+    broker: Broker,
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    first_byte: bytes,
+) -> None:
+    """Serve one MQTT connection (first fixed-header byte already read)."""
+    session: Optional[Session] = None
+    pump: Optional[asyncio.Task] = None
+    out_mid = itertools.count(1)
+
+    def send(pkt) -> None:
+        writer.write(mc.encode(pkt))
+
+    async def pump_session(s: Session) -> None:
+        try:
+            while s.queue is not None:
+                msg = await s.queue.get()
+                if msg is None:
+                    break
+                send(
+                    mc.Publish(
+                        topic=msg.topic,
+                        payload=msg.payload.encode("utf-8"),
+                        qos=msg.qos,
+                        mid=next(out_mid) if msg.qos > 0 else None,
+                    )
+                )
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+    keepalive = 60
+    try:
+        pkt = await mc.read_packet(reader, first_byte)
+        if not isinstance(pkt, mc.Connect):
+            return
+        keepalive = pkt.keepalive or 60
+        try:
+            session = broker.attach(
+                pkt.client_id or f"mqtt-{next(_ids)}",
+                pkt.username or "",
+                pkt.password or "",
+                pkt.clean_session,
+            )
+        except AuthError:
+            send(mc.Connack(return_code=mc.CONNACK_BAD_CREDENTIALS))
+            await writer.drain()
+            return
+        # Session-present: an existing durable session was resumed.
+        resumed = not pkt.clean_session and bool(session.subscriptions)
+        send(mc.Connack(return_code=mc.CONNACK_ACCEPTED, session_present=resumed))
+        await writer.drain()
+        pump = asyncio.ensure_future(pump_session(session))
+
+        while True:
+            timeout = keepalive * 1.5 if keepalive else None
+            try:
+                pkt = await asyncio.wait_for(mc.read_packet(reader), timeout)
+            except asyncio.TimeoutError:
+                logger.debug("mqtt keepalive expired for %s", session.client_id)
+                break
+            if pkt is None or isinstance(pkt, mc.Disconnect):
+                break
+            if isinstance(pkt, mc.Pingreq):
+                send(mc.Pingresp())
+            elif isinstance(pkt, mc.Publish):
+                payload = pkt.payload.decode("utf-8", errors="replace")
+                try:
+                    broker.publish(session, pkt.topic, payload, pkt.qos)
+                except AuthError:
+                    # 3.1.1 has no per-publish NACK; denial = drop (exactly
+                    # mosquitto's ACL behavior).
+                    logger.debug(
+                        "denied publish to %s by %s", pkt.topic, session.username
+                    )
+                if pkt.qos >= QOS_1 and pkt.mid is not None:
+                    send(mc.Puback(mid=pkt.mid))
+            elif isinstance(pkt, mc.Subscribe):
+                codes = []
+                for pattern, qos in pkt.topics:
+                    try:
+                        broker.subscribe(session, pattern, min(qos, QOS_1))
+                        codes.append(min(qos, QOS_1))
+                    except AuthError:
+                        codes.append(mc.SUBACK_FAILURE)
+                send(mc.Suback(mid=pkt.mid, codes=codes))
+            elif isinstance(pkt, mc.Unsubscribe):
+                for pattern in pkt.topics:
+                    broker.unsubscribe(session, pattern)
+                send(mc.Unsuback(mid=pkt.mid))
+            await writer.drain()
+    except (
+        ConnectionError,
+        asyncio.IncompleteReadError,
+        mc.MqttCodecError,
+    ) as e:
+        logger.debug("mqtt connection ended: %r", e)
+    finally:
+        if pump is not None:
+            pump.cancel()
+        if session is not None:
+            broker.detach(session)
+
+
+class MqttTransport(TcpTransport):
+    """MQTT 3.1.1 client endpoint (this broker or a stock Mosquitto).
+
+    Built by swapping TcpTransport's JSON frame layer for MQTT packets; all
+    connection management (backoff reconnect, subscription replay, ack
+    futures, bounded inbox) is inherited.
+    """
+
+    SCHEMES = ("mqtt",)
+
+    _sub_mid = None  # lazy counter for SUBSCRIBE/UNSUBSCRIBE packet ids
+
+    def _next_sub_mid(self) -> int:
+        if self._sub_mid is None:
+            self._sub_mid = itertools.count(1)
+        return next(self._sub_mid) % 65535 + 1
+
+    async def _send(self, obj: dict) -> None:
+        if self._writer is None:
+            raise TransportError("not connected")
+        op = obj["op"]
+        if op == "connect":
+            pkt = mc.Connect(
+                client_id=obj["client_id"],
+                username=obj["username"] or None,
+                password=obj["password"] or None,
+                clean_session=obj["clean_session"],
+                keepalive=60,
+            )
+        elif op == "pub":
+            pkt = mc.Publish(
+                topic=obj["topic"],
+                payload=obj["payload"].encode("utf-8"),
+                qos=obj["qos"],
+                mid=obj.get("mid"),
+            )
+        elif op == "sub":
+            pkt = mc.Subscribe(
+                mid=self._next_sub_mid(), topics=[(obj["pattern"], obj["qos"])]
+            )
+        elif op == "unsub":
+            pkt = mc.Unsubscribe(mid=self._next_sub_mid(), topics=[obj["pattern"]])
+        elif op == "ping":
+            pkt = mc.Pingreq()
+        else:
+            raise TransportError(f"cannot express {op!r} in MQTT")
+        self._writer.write(mc.encode(pkt))
+        await self._writer.drain()
+
+    async def _read_frame(self) -> Optional[dict]:
+        while True:
+            if self._reader is None:
+                return None
+            try:
+                pkt = await mc.read_packet(self._reader)
+            except mc.MqttCodecError as e:
+                # Undecodable stream = broken session: treat as a drop so
+                # the rx loop reconnects instead of dying.
+                logger.warning("mqtt stream error: %s", e)
+                return None
+            if pkt is None:
+                return None
+            if isinstance(pkt, mc.Connack):
+                if pkt.return_code == mc.CONNACK_ACCEPTED:
+                    return {"op": "connack"}
+                return {"op": "error", "reason": f"bad credentials (rc={pkt.return_code})"}
+            if isinstance(pkt, mc.Publish):
+                if pkt.qos >= QOS_1 and pkt.mid is not None:
+                    self._writer.write(mc.encode(mc.Puback(mid=pkt.mid)))
+                return {
+                    "op": "msg",
+                    "topic": pkt.topic,
+                    "payload": pkt.payload.decode("utf-8", errors="replace"),
+                    "qos": pkt.qos,
+                }
+            if isinstance(pkt, mc.Puback):
+                return {"op": "puback", "mid": pkt.mid}
+            if isinstance(pkt, mc.Pingresp):
+                return {"op": "pong"}
+            if isinstance(pkt, (mc.Suback, mc.Unsuback)):
+                continue  # TcpTransport does not await these
+            logger.debug("ignoring mqtt packet %r", pkt)
+
+    # MQTT publish mids must fit 16 bits; TcpTransport's counter is fine for
+    # the JSON face but must wrap here.
+    async def publish(self, topic: str, payload: str, qos: int = 0) -> None:
+        if qos >= QOS_1:
+            # Wrap the shared counter into the u16 space MQTT requires.
+            mid = next(self._mid) % 65000 + 1
+            fut = asyncio.get_running_loop().create_future()
+            self._acks[mid] = fut
+            await self._send({"op": "pub", "topic": topic, "payload": payload,
+                              "qos": qos, "mid": mid})
+            try:
+                await asyncio.wait_for(fut, timeout=10.0)
+            except asyncio.TimeoutError:
+                self._acks.pop(mid, None)
+                raise TransportError(f"no puback for publish to {topic}")
+        else:
+            await TcpTransport.publish(self, topic, payload, qos)
