@@ -298,3 +298,103 @@ def test_mqtt_rx_survives_mid_packet_cut():
             await srv.wait_closed()
 
     run(main())
+
+
+def test_mqtt_over_websocket_browser_client():
+    """A stock MQTT-over-websockets client (mqtt.js-style: binary frames,
+    'mqtt' subprotocol) joins through the ws face and hears a JSON-lines
+    TCP publisher — the reference's port-9001 dashboard path (reference
+    server/setup/mosquitto/dpow.conf:7-8)."""
+    import aiohttp
+
+    from tpu_dpow.transport.ws import WsBrokerServer
+
+    async def main():
+        broker = Broker()
+        tcp = TcpBrokerServer(broker, port=0)
+        ws_srv = WsBrokerServer(broker, port=0)
+        await tcp.start()
+        await ws_srv.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                ws = await http.ws_connect(
+                    f"ws://127.0.0.1:{ws_srv.port}/mqtt", protocols=("mqtt",)
+                )
+                assert ws.protocol == "mqtt"  # subprotocol negotiated
+                await ws.send_bytes(
+                    mc.encode(mc.Connect(client_id="dash", clean_session=True))
+                )
+                raw = await ws.receive_bytes()
+                assert mc.decode(raw[0], raw[2:]).return_code == 0
+                await ws.send_bytes(
+                    mc.encode(mc.Subscribe(mid=1, topics=[("statistics", 0)]))
+                )
+                raw = await ws.receive_bytes()
+                assert isinstance(mc.decode(raw[0], raw[2:]), mc.Suback)
+
+                pub = TcpTransport(port=tcp.port, client_id="srv")
+                await pub.connect()
+                await pub.publish("statistics", '{"totals": 1}', QOS_0)
+                raw = await ws.receive_bytes()
+                got = mc.decode(raw[0], raw[2:])
+                assert isinstance(got, mc.Publish)
+                assert (got.topic, got.payload) == ("statistics", b'{"totals": 1}')
+                await pub.close()
+                await ws.close()
+        finally:
+            await ws_srv.stop()
+            await tcp.stop()
+
+    run(main())
+
+
+def test_session_takeover_kicks_old_connection():
+    """A reconnect with the same client_id while the old connection lingers
+    must hand the durable session to the NEW connection: old pump poisoned,
+    stale detach must not null the live queue (mosquitto kicks the old
+    client the same way)."""
+
+    async def main():
+        srv = await _start_broker()
+        try:
+            old = MqttTransport(port=srv.port, client_id="dup",
+                                clean_session=False, reconnect_retries=1)
+            await old.connect()
+            await old.subscribe("work/#", QOS_1)
+            await asyncio.sleep(0.05)
+
+            new = MqttTransport(port=srv.port, client_id="dup",
+                                clean_session=False)
+            await new.connect()
+            await asyncio.sleep(0.05)
+
+            pub = MqttTransport(port=srv.port, client_id="pub")
+            await pub.connect()
+            await pub.publish("work/ondemand", "FRESH", QOS_1)
+            # the NEW connection (which inherited the durable subscription)
+            # gets the message; the old one was kicked
+            msg = await anext(aiter(new.messages()))
+            assert msg.payload == "FRESH"
+            await pub.close()
+            await new.close()
+            await old.close()
+        finally:
+            await srv.stop()
+
+    run(main())
+
+
+def test_server_mid_wraps_past_16_bits():
+    """QoS-1 delivery mids must wrap within u16 — the 65536th message to one
+    connection must not kill the pump (regression: OverflowError)."""
+    import itertools as it
+
+    from tpu_dpow.transport import mqtt as mqtt_mod
+
+    # Simulate the counter deep into a long-lived connection: encode with
+    # the same expression pump_session uses, at the wrap boundary.
+    out_mid = it.count(65534)
+    for _ in range(4):
+        mid = next(out_mid) % 65000 + 1
+        raw = mc.encode(mc.Publish(topic="t", payload=b"", qos=1, mid=mid))
+        assert 1 <= mc.decode(raw[0], raw[2:]).mid <= 65000

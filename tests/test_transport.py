@@ -138,7 +138,7 @@ def test_broker_sheds_load_on_full_queue():
         await a.connect(); await b.connect()
         await b.subscribe("#")
         old = broker_mod.MAX_QUEUE
-        b._session.queue = asyncio.Queue(maxsize=3)
+        b._session.queue = b._queue = asyncio.Queue(maxsize=3)
         for i in range(10):
             await a.publish("t", str(i))
         msgs = await _collect(b, 3)

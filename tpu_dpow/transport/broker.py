@@ -69,6 +69,15 @@ class Broker:
     ) -> Session:
         self.authenticate(username, password)
         session = self.sessions.get(client_id)
+        if session is not None and session.queue is not None:
+            # Session takeover (same client_id reconnects while the old
+            # connection lingers, e.g. a NAT-dropped socket): kick the old
+            # pump with a poison pill so the new connection owns the
+            # session — mosquitto likewise disconnects the prior client.
+            try:
+                session.queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
         if session is None or clean_session or session.clean:
             session = Session(client_id=client_id, username=username, clean=clean_session)
             self.sessions[client_id] = session
@@ -80,7 +89,11 @@ class Broker:
         session.offline.clear()
         return session
 
-    def detach(self, session: Session) -> None:
+    def detach(self, session: Session, queue: Optional[asyncio.Queue] = None) -> None:
+        if queue is not None and session.queue is not queue:
+            # Stale detach from a taken-over connection: the session now
+            # belongs to a newer connection — don't null ITS queue.
+            return
         session.queue = None
         if session.clean:
             self.sessions.pop(session.client_id, None)

@@ -33,6 +33,7 @@ class FrameConn:
         self.broker = broker
         self.kind = kind
         self.session: Optional[Session] = None
+        self.queue = None  # the queue THIS connection installed at attach
 
     def handle(self, frame: dict, send: Callable[[dict], None]) -> bool:
         try:
@@ -57,6 +58,7 @@ class FrameConn:
             except AuthError as e:
                 send({"op": "error", "reason": str(e)})
                 return False
+            self.queue = self.session.queue
             send({"op": "connack"})
         elif self.session is None:
             send({"op": "error", "reason": "not connected"})
@@ -90,5 +92,6 @@ class FrameConn:
 
     def detach(self) -> None:
         if self.session is not None:
-            self.broker.detach(self.session)
+            self.broker.detach(self.session, self.queue)
             self.session = None
+            self.queue = None

@@ -39,6 +39,7 @@ class InProcTransport(Transport):
         self._session = self.broker.attach(
             self.client_id, self.username, self.password, self.clean_session
         )
+        self._queue = self._session.queue  # the queue THIS connect installed
 
     @property
     def connected(self) -> bool:
@@ -56,19 +57,20 @@ class InProcTransport(Transport):
         self.broker.subscribe(self._require(), pattern, qos)
 
     async def messages(self) -> AsyncIterator[Message]:
-        session = self._require()
-        while session.queue is not None:
+        self._require()
+        queue = self._queue  # captured: a session takeover owns a new one
+        while True:
             # CancelledError must propagate: callers wrap this iterator in
             # wait_for and rely on cancellation actually cancelling.
-            msg = await session.queue.get()
-            if msg is None:  # close() sentinel
+            msg = await queue.get()
+            if msg is None:  # close()/takeover sentinel
                 break
             yield msg
 
     async def close(self) -> None:
         if self._session is not None:
-            queue = self._session.queue
-            self.broker.detach(self._session)
+            queue = self._queue
+            self.broker.detach(self._session, queue)
             if queue is not None:
                 # Wake any consumer blocked in messages().
                 try:

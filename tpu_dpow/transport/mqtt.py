@@ -42,21 +42,30 @@ _ids = itertools.count()
 async def handle_mqtt_conn(
     broker: Broker,
     reader: asyncio.StreamReader,
-    writer: asyncio.StreamWriter,
-    first_byte: bytes,
+    writer,  # StreamWriter or any write()/drain() shim (websocket face)
+    first_byte: Optional[bytes],
 ) -> None:
-    """Serve one MQTT connection (first fixed-header byte already read)."""
+    """Serve one MQTT connection.
+
+    ``first_byte``: the fixed-header byte a protocol-sniffing caller already
+    consumed (transport/tcp.py), or None when the stream still holds it
+    (transport/ws.py feeds whole frames into its reader).
+    """
     session: Optional[Session] = None
+    my_queue = None  # the queue THIS connection installed at attach
     pump: Optional[asyncio.Task] = None
     out_mid = itertools.count(1)
 
     def send(pkt) -> None:
         writer.write(mc.encode(pkt))
 
-    async def pump_session(s: Session) -> None:
+    async def pump_session(queue: asyncio.Queue) -> None:
+        # Captured queue, not session.queue: after a session takeover a
+        # newer connection owns a fresh queue (see broker.attach), and this
+        # pump gets a None poison pill on its own.
         try:
-            while s.queue is not None:
-                msg = await s.queue.get()
+            while True:
+                msg = await queue.get()
                 if msg is None:
                     break
                 send(
@@ -64,7 +73,8 @@ async def handle_mqtt_conn(
                         topic=msg.topic,
                         payload=msg.payload.encode("utf-8"),
                         qos=msg.qos,
-                        mid=next(out_mid) if msg.qos > 0 else None,
+                        # MQTT packet ids are u16 and nonzero: wrap.
+                        mid=(next(out_mid) % 65000 + 1) if msg.qos > 0 else None,
                     )
                 )
                 await writer.drain()
@@ -76,7 +86,7 @@ async def handle_mqtt_conn(
         pkt = await mc.read_packet(reader, first_byte)
         if not isinstance(pkt, mc.Connect):
             return
-        keepalive = pkt.keepalive or 60
+        keepalive = pkt.keepalive  # 0 = client disabled keepalive (§3.1.2.10)
         try:
             session = broker.attach(
                 pkt.client_id or f"mqtt-{next(_ids)}",
@@ -88,11 +98,12 @@ async def handle_mqtt_conn(
             send(mc.Connack(return_code=mc.CONNACK_BAD_CREDENTIALS))
             await writer.drain()
             return
+        my_queue = session.queue
         # Session-present: an existing durable session was resumed.
         resumed = not pkt.clean_session and bool(session.subscriptions)
         send(mc.Connack(return_code=mc.CONNACK_ACCEPTED, session_present=resumed))
         await writer.drain()
-        pump = asyncio.ensure_future(pump_session(session))
+        pump = asyncio.ensure_future(pump_session(my_queue))
 
         while True:
             timeout = keepalive * 1.5 if keepalive else None
@@ -141,7 +152,7 @@ async def handle_mqtt_conn(
         if pump is not None:
             pump.cancel()
         if session is not None:
-            broker.detach(session)
+            broker.detach(session, my_queue)
 
 
 class MqttTransport(TcpTransport):
@@ -154,7 +165,35 @@ class MqttTransport(TcpTransport):
 
     SCHEMES = ("mqtt",)
 
+    #: keepalive declared in CONNECT; the pinger sends PINGREQ at half this
+    #: so an idle subscriber (a worker listening for work/#) is never
+    #: dropped by this broker's — or Mosquitto's — 1.5x inactivity cutoff.
+    KEEPALIVE = 60.0
+
     _sub_mid = None  # lazy counter for SUBSCRIBE/UNSUBSCRIBE packet ids
+    _ping_task: Optional[asyncio.Task] = None
+
+    async def _connect_once(self) -> None:
+        await super()._connect_once()
+        if self._ping_task is None or self._ping_task.done():
+            self._ping_task = asyncio.ensure_future(self._ping_loop())
+
+    async def _ping_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(self.KEEPALIVE / 2)
+            if self._closed:
+                return
+            if self._connected:
+                try:
+                    await self._send({"op": "ping"})
+                except Exception:
+                    pass  # the rx loop owns drop detection / reconnect
+
+    async def close(self) -> None:
+        if self._ping_task is not None:
+            self._ping_task.cancel()
+            self._ping_task = None
+        await super().close()
 
     def _next_sub_mid(self) -> int:
         if self._sub_mid is None:
@@ -171,7 +210,7 @@ class MqttTransport(TcpTransport):
                 username=obj["username"] or None,
                 password=obj["password"] or None,
                 clean_session=obj["clean_session"],
-                keepalive=60,
+                keepalive=int(self.KEEPALIVE),
             )
         elif op == "pub":
             pkt = mc.Publish(

@@ -34,7 +34,7 @@ import logging
 from typing import AsyncIterator, Dict, Optional
 
 from . import AuthError, Message, QOS_0, QOS_1, Transport, TransportError, User
-from .broker import Broker, Session
+from .broker import Broker
 from .frames import FrameConn
 
 logger = logging.getLogger(__name__)
@@ -94,10 +94,13 @@ class TcpBrokerServer:
                 return
             pending = first
             while True:
-                line = pending + await reader.readline()
+                tail = await reader.readline()
+                line = pending + tail
                 pending = b""
-                if not line or line == first:
-                    break
+                if not line:
+                    break  # clean EOF
+                if not tail and line == first:
+                    break  # EOF straight after the sniffed byte
                 if len(line) > MAX_LINE:
                     send({"op": "error", "reason": "line too long"})
                     break
@@ -112,7 +115,7 @@ class TcpBrokerServer:
                 if not keep:
                     break
                 if conn.session is not None and sender is None:
-                    sender = asyncio.ensure_future(self._pump(conn.session, writer))
+                    sender = asyncio.ensure_future(self._pump(conn.queue, writer))
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -126,11 +129,16 @@ class TcpBrokerServer:
             except Exception:
                 pass
 
-    async def _pump(self, session: Session, writer: asyncio.StreamWriter) -> None:
-        """Forward the session's queue to the socket."""
+    async def _pump(self, queue: asyncio.Queue, writer: asyncio.StreamWriter) -> None:
+        """Forward this connection's queue to the socket.
+
+        The queue object is captured, not re-read from the session: after a
+        session takeover a newer connection owns a fresh queue, and the old
+        pump must drain only its own (it gets a None poison pill).
+        """
         try:
-            while session.queue is not None:
-                msg = await session.queue.get()
+            while True:
+                msg = await queue.get()
                 if msg is None:
                     break
                 writer.write(

@@ -3,10 +3,16 @@
 Parity with the reference's MQTT-over-websockets listener on port 9001
 (reference server/setup/mosquitto/dpow.conf:7-8) proxied at ``/mqtt/`` by
 nginx (reference server/setup/nginx/dpow:9-14), which is what its live MQTT
-dashboard rides on (reference server/README.md:133-135). The rebuild speaks
-the same JSON frames as the TCP face (contract in transport/tcp.py), one
-JSON object per websocket text message — a browser joins the swarm with the
-stock ``WebSocket`` API and ``JSON.stringify``, no MQTT library needed:
+dashboard rides on (reference server/README.md:133-135). TWO dialects share
+the listener, distinguished by the first websocket message:
+
+  * **real MQTT over binary frames** (subprotocol "mqtt") — stock browser
+    MQTT clients (mqtt.js & co.) connect exactly as they would to
+    Mosquitto's websockets listener; packets bridge into the shared MQTT
+    handler (transport/mqtt.py);
+  * **JSON text frames** — the same contract as the TCP face
+    (transport/tcp.py), one JSON object per message, so a browser can also
+    join with the stock ``WebSocket`` API and no MQTT library at all:
 
     const ws = new WebSocket("wss://host/mqtt/");
     ws.onopen = () => {
@@ -31,7 +37,7 @@ from typing import Optional
 from aiohttp import ClientSession, WSMsgType, web
 
 from . import TransportError
-from .broker import Broker, Session
+from .broker import Broker
 from .frames import FrameConn
 from .tcp import TcpTransport
 
@@ -78,7 +84,11 @@ class WsBrokerServer:
             self._runner = None
 
     async def _handle(self, request: web.Request) -> web.WebSocketResponse:
-        ws = web.WebSocketResponse(heartbeat=30)
+        # protocols=("mqtt",): stock browser MQTT clients (mqtt.js & co.)
+        # request the "mqtt" websocket subprotocol, exactly as against
+        # Mosquitto's websockets listener (reference
+        # server/setup/mosquitto/dpow.conf:7-8).
+        ws = web.WebSocketResponse(heartbeat=30, protocols=("mqtt",))
         await ws.prepare(request)
         conn = FrameConn(self.broker, "ws")
         pump: Optional[asyncio.Task] = None
@@ -86,6 +96,13 @@ class WsBrokerServer:
         self._conns.add(ws)
         try:
             async for msg in ws:
+                if msg.type == WSMsgType.BINARY and msg.data[:1] == b"\x10":
+                    # MQTT CONNECT in a binary frame: this is a stock MQTT-
+                    # over-websockets client, not a JSON one. Bridge the
+                    # websocket into the shared MQTT handler via a stream
+                    # adapter and let it own the rest of the connection.
+                    await self._serve_mqtt(ws, msg.data)
+                    break
                 if msg.type != WSMsgType.TEXT:
                     break
                 try:
@@ -100,7 +117,7 @@ class WsBrokerServer:
                 if not keep:
                     break
                 if conn.session is not None and pump is None:
-                    pump = asyncio.ensure_future(self._pump(conn.session, ws))
+                    pump = asyncio.ensure_future(self._pump(conn.queue, ws))
         except ConnectionError:
             pass
         finally:
@@ -111,10 +128,46 @@ class WsBrokerServer:
             await ws.close()
         return ws
 
-    async def _pump(self, session: Session, ws: web.WebSocketResponse) -> None:
+    async def _serve_mqtt(self, ws: web.WebSocketResponse, first: bytes) -> None:
+        """One MQTT session over websocket binary frames.
+
+        Reuses the TCP MQTT handler (transport/mqtt.py) through a
+        StreamReader fed from websocket messages and a writer shim that
+        flushes buffered packet bytes as binary frames.
+        """
+        from .mqtt import handle_mqtt_conn
+
+        reader = asyncio.StreamReader()
+        reader.feed_data(first)
+
+        async def feed() -> None:
+            try:
+                async for m in ws:
+                    if m.type != WSMsgType.BINARY:
+                        break
+                    # Backpressure: a transportless StreamReader buffers
+                    # without bound; don't outrun the MQTT handler.
+                    while len(getattr(reader, "_buffer", b"")) > 1 << 20:
+                        await asyncio.sleep(0.02)
+                        if reader.at_eof():
+                            return
+                    reader.feed_data(m.data)
+            except ConnectionError:
+                pass
+            finally:
+                reader.feed_eof()
+
+        feeder = asyncio.ensure_future(feed())
         try:
-            while session.queue is not None:
-                msg = await session.queue.get()
+            await handle_mqtt_conn(self.broker, reader, _WsWriterShim(ws), None)
+        finally:
+            feeder.cancel()
+
+    async def _pump(self, queue: asyncio.Queue, ws: web.WebSocketResponse) -> None:
+        # Captured queue, not session.queue: see TcpBrokerServer._pump.
+        try:
+            while True:
+                msg = await queue.get()
                 if msg is None:
                     break
                 await ws.send_json(
@@ -122,6 +175,28 @@ class WsBrokerServer:
                 )
         except (ConnectionError, asyncio.CancelledError):
             pass
+
+
+class _WsWriterShim:
+    """StreamWriter-shaped adapter: buffered writes → binary ws frames.
+
+    Implements exactly the surface transport/mqtt.py's handler uses
+    (write + drain); each drain ships the accumulated packet bytes as one
+    websocket binary message.
+    """
+
+    def __init__(self, ws: web.WebSocketResponse):
+        self._ws = ws
+        self._buf = bytearray()
+
+    def write(self, data: bytes) -> None:
+        self._buf += data
+
+    async def drain(self) -> None:
+        if self._buf and not self._ws.closed:
+            data = bytes(self._buf)
+            self._buf.clear()
+            await self._ws.send_bytes(data)
 
 
 class WsTransport(TcpTransport):
