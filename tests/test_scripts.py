@@ -215,3 +215,42 @@ def test_latency_probe_times_work_result_cancel():
     assert len(probe.result_deltas) == 1
     assert len(probe.cancel_deltas) == 1
     assert probe.summary()["results"] == 1
+
+
+def test_latency_probe_over_mqtt_wire():
+    """The probe observes the swarm over real MQTT (reference parity: its
+    probe is a paho MQTT client, reference server/scripts/check_latency.py)."""
+    from tpu_dpow.transport.mqtt import MqttTransport
+    from tpu_dpow.transport.tcp import TcpBrokerServer
+
+    async def flow():
+        # Authenticated broker with the REAL ACL matrix: the probe's
+        # dpowinterface identity must be granted its work/result/cancel
+        # subscriptions exactly as the reference's acls grant them.
+        from tpu_dpow.transport import default_users
+
+        broker = Broker(users=default_users())
+        srv = TcpBrokerServer(broker, port=0)
+        await srv.start()
+        observer = MqttTransport(
+            port=srv.port, username="dpowinterface", password="dpowinterface",
+            client_id="probe",
+        )
+        probe = cl.LatencyProbe(observer, quiet=True)
+        server = InProcTransport(broker, username="dpowserver", password="dpowserver")
+        await server.connect()
+        runner = asyncio.ensure_future(probe.run())
+        await asyncio.sleep(0.1)
+        h = "C" * 64
+        await server.publish("work/ondemand", f"{h},ffffffc000000000")
+        await asyncio.sleep(0.05)
+        await server.publish("cancel/ondemand", h)
+        await asyncio.sleep(0.1)
+        runner.cancel()
+        await observer.close()
+        await server.close()
+        await srv.stop()
+        return probe
+
+    probe = run(flow())
+    assert probe.summary()["cancels"] == 1

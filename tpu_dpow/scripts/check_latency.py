@@ -105,12 +105,30 @@ async def amain(argv=None) -> int:
     p.add_argument("--port", type=int, default=1883)
     p.add_argument("--username", default="dpowinterface")
     p.add_argument("--password", default="dpowinterface")
+    p.add_argument("--uri", default=None,
+                   help="full broker URI (tcp:// | mqtt:// | ws://) overriding "
+                   "host/port — mqtt:// also observes a stock Mosquitto, like "
+                   "the reference's paho probe")
     p.add_argument("--duration", type=float, default=None, help="seconds; default forever")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
-    transport = TcpTransport(
-        args.host, args.port, username=args.username, password=args.password
-    )
+    if args.uri:
+        from urllib.parse import urlparse, urlunparse
+
+        from ..transport import transport_from_uri
+
+        u = urlparse(args.uri)
+        if not u.username:
+            # Merge the credential flags into a URI given without userinfo.
+            netloc = f"{args.username}:{args.password}@{u.hostname or '127.0.0.1'}"
+            if u.port:
+                netloc += f":{u.port}"
+            args.uri = urlunparse((u.scheme, netloc, u.path, "", u.query, ""))
+        transport = transport_from_uri(args.uri)
+    else:
+        transport = TcpTransport(
+            args.host, args.port, username=args.username, password=args.password
+        )
     probe = LatencyProbe(transport, quiet=args.quiet)
     try:
         await probe.run(args.duration)

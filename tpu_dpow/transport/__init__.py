@@ -153,6 +153,12 @@ def default_users(server_password: str = "dpowserver", client_password: str = "c
         "dpowinterface": User(
             password="dpowinterface",
             acl_pub=(),
-            acl_sub=("statistics", "client/#", "heartbeat"),
+            # Read-everything observer (reference acls gives dpowinterface
+            # read on every topic, /root/reference/server/setup/mosquitto/
+            # acls:22-31) — the latency probe subscribes work/result/cancel.
+            acl_sub=(
+                "work/#", "cancel/#", "result/#",
+                "statistics", "client/#", "heartbeat",
+            ),
         ),
     }

@@ -1,9 +1,12 @@
 """Standalone broker: ``python -m tpu_dpow.transport [--listen ...] [--users ...]``.
 
 The rebuild's deployable stand-in for the reference's Mosquitto process
-(reference server/setup/mosquitto/dpow.conf + acls): a TCP pub/sub broker
-with the same topic contract, QoS levels, and per-user ACL matrix, but run
-from this package instead of an external C daemon. Single-host deployments
+(reference server/setup/mosquitto/dpow.conf + acls): a pub/sub broker with
+the same topic contract, QoS levels, and per-user ACL matrix, but run from
+this package instead of an external C daemon. The TCP listener serves BOTH
+real MQTT 3.1.1 and the JSON-lines protocol (auto-detected per connection),
+and the optional websocket listener likewise serves MQTT-over-websockets
+and JSON text frames — stock paho/hbmqtt/mqtt.js clients connect unmodified. Single-host deployments
 can skip it entirely (`--inproc_broker` on the server embeds one); this
 entrypoint exists for multi-host swarms where workers connect over the
 network.
