@@ -141,3 +141,150 @@ def test_get_store_factory():
     assert isinstance(get_store("memory"), MemoryStore)
     with pytest.raises(ValueError):
         get_store("mongodb://nope")
+
+
+# ------------------------------------------------------------ SqliteStore
+# Durable stdlib-only store: same contract, state survives a process
+# restart (the reference needs a running Redis for this, SURVEY.md §5.4).
+
+
+def _sqlite(tmp_path):
+    from tpu_dpow.store.sqlite_store import SqliteStore
+
+    return SqliteStore(str(tmp_path / "dpow.db"))
+
+
+def test_sqlite_kv_hash_set_contract(tmp_path):
+    async def main():
+        s = _sqlite(tmp_path)
+        await s.setup()
+        await s.set("block:AA", "pending")
+        assert await s.get("block:AA") == "pending"
+        assert await s.exists("block:AA")
+        assert await s.incrby("stats:ondemand", 5) == 5
+        assert await s.incrby("stats:ondemand") == 6
+        await s.hset("client:addr", {"ondemand": "1", "precache": "2"})
+        assert await s.hget("client:addr", "precache") == "2"
+        assert await s.hincrby("client:addr", "ondemand", 2) == 3
+        assert await s.hgetall("client:addr") == {"ondemand": "3", "precache": "2"}
+        await s.sadd("services", "a", "b")
+        await s.srem("services", "a")
+        assert await s.smembers("services") == {"b"}
+        assert sorted(await s.keys("client:*")) == ["client:addr"]
+        assert await s.delete("block:AA", "missing") == 1
+        assert await s.get("block:AA") is None
+        await s.close()
+
+    asyncio.run(main())
+
+
+def test_sqlite_ttl_expiry_and_setnx_lock(tmp_path):
+    async def main():
+        import time as _time
+
+        s = _sqlite(tmp_path)
+        await s.setup()
+        await s.set("block-difficulty:AA", "fff", expire=0.05)
+        assert await s.get("block-difficulty:AA") == "fff"
+        # winner lock: first setnx wins, second loses while alive
+        assert await s.setnx("block-lock:AA", "1", expire=0.05) is True
+        assert await s.setnx("block-lock:AA", "1", expire=0.05) is False
+        _time.sleep(0.07)
+        assert await s.get("block-difficulty:AA") is None
+        assert await s.setnx("block-lock:AA", "1") is True  # expired -> free
+        assert s.sweep() >= 0
+        await s.close()
+
+    asyncio.run(main())
+
+
+def test_sqlite_state_survives_restart(tmp_path):
+    async def main():
+        s = _sqlite(tmp_path)
+        await s.setup()
+        await s.set("account:nano_x", "FRONTIER")
+        await s.hset("service:svc", {"api_key": "k"})
+        await s.sadd("services", "svc")
+        await s.close()
+
+        s2 = _sqlite(tmp_path)
+        await s2.setup()
+        assert await s2.get("account:nano_x") == "FRONTIER"
+        assert await s2.hget("service:svc", "api_key") == "k"
+        assert await s2.smembers("services") == {"svc"}
+        await s2.close()
+
+    asyncio.run(main())
+
+
+def test_sqlite_get_store_uri(tmp_path):
+    from tpu_dpow.store import get_store
+    from tpu_dpow.store.sqlite_store import SqliteStore
+
+    s = get_store(f"sqlite://{tmp_path}/x.db")
+    assert isinstance(s, SqliteStore)
+    assert s.path == f"{tmp_path}/x.db"
+
+
+def test_sqlite_server_runs_on_it(tmp_path):
+    """The orchestrator's hot path (precache-hit bookkeeping, winner lock,
+    client credit) works unchanged on the sqlite store."""
+    from tpu_dpow.server import DpowServer, ServerConfig
+    from tpu_dpow.transport.broker import Broker
+    from tpu_dpow.transport.inproc import InProcTransport
+
+    async def main():
+        s = _sqlite(tmp_path)
+        await s.setup()
+        config = ServerConfig(
+            base_difficulty=0xFF00000000000000, throttle=1000.0,
+            heartbeat_interval=3600.0, statistics_interval=3600.0,
+            service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+        )
+        broker = Broker()
+        server = DpowServer(config, s, InProcTransport(broker, client_id="srv"))
+        await server.setup()
+        h = "AB" * 32
+        # direct store path exercised by the orchestrator
+        await s.set(f"block:{h}", "feedbeef00000000")
+        await s.set(f"work-type:{h}", "precache")
+        assert await s.get(f"block:{h}") == "feedbeef00000000"
+        await server.close()
+        await s.close()
+
+    asyncio.run(main())
+
+
+def test_sqlite_type_mismatch_raises(tmp_path):
+    async def main():
+        s = _sqlite(tmp_path)
+        await s.setup()
+        await s.set("k1", "v")
+        with pytest.raises(TypeError):
+            await s.hset("k1", {"f": "v"})
+        with pytest.raises(TypeError):
+            await s.sadd("k1", "m")
+        await s.hset("h1", {"f": "v"})
+        with pytest.raises(TypeError):
+            await s.set("h1", "v")
+        with pytest.raises(TypeError):
+            await s.incrby("h1")
+        await s.close()
+
+    asyncio.run(main())
+
+
+def test_sqlite_incrby_preserves_ttl(tmp_path):
+    async def main():
+        import time as _time
+
+        s = _sqlite(tmp_path)
+        await s.setup()
+        await s.set("counter", "1", expire=0.08)
+        assert await s.incrby("counter", 2) == 3
+        assert await s.get("counter") == "3"
+        _time.sleep(0.1)
+        assert await s.get("counter") is None  # TTL survived the incrby
+        await s.close()
+
+    asyncio.run(main())

@@ -113,14 +113,16 @@ async def amain(argv=None) -> int:
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
     if args.uri:
-        from urllib.parse import urlparse, urlunparse
+        from urllib.parse import quote, urlparse, urlunparse
 
         from ..transport import transport_from_uri
 
         u = urlparse(args.uri)
         if not u.username:
-            # Merge the credential flags into a URI given without userinfo.
-            netloc = f"{args.username}:{args.password}@{u.hostname or '127.0.0.1'}"
+            # Merge the credential flags into a URI given without userinfo
+            # (percent-encoded: passwords may hold /, ?, @, #).
+            creds = f"{quote(args.username, safe='')}:{quote(args.password, safe='')}"
+            netloc = f"{creds}@{u.hostname or '127.0.0.1'}"
             if u.port:
                 netloc += f":{u.port}"
             args.uri = urlunparse((u.scheme, netloc, u.path, "", u.query, ""))

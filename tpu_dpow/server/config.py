@@ -63,7 +63,9 @@ def parse_args(argv=None) -> ServerConfig:
     p.add_argument("--web_path", default=None, help="unix socket path for the service API")
     p.add_argument("--transport_uri", default=os.getenv("TRANSPORT_SECRET_URI", c.transport_uri))
     p.add_argument("--inproc_broker", action="store_true")
-    p.add_argument("--store_uri", default=c.store_uri)
+    p.add_argument("--store_uri", default=c.store_uri,
+                   help="memory | sqlite:///path.db (durable, stdlib) | "
+                   "redis://host (needs the redis package)")
     p.add_argument("--checkpoint_path", default=None)
     p.add_argument("--websocket_uri", dest="node_ws_uri", default=None)
     p.add_argument("--no_precache", dest="enable_precache", action="store_false")

@@ -278,9 +278,15 @@ class MemoryStore(Store):
 
 
 def get_store(uri: Optional[str] = None, **kwargs) -> Store:
-    """'memory' / None → MemoryStore; 'redis://...' → RedisStore (if installed)."""
+    """'memory' / None → MemoryStore; 'sqlite:///path' → SqliteStore
+    (durable, stdlib-only); 'redis://...' → RedisStore (if installed)."""
     if uri is None or uri == "memory":
         return MemoryStore(**kwargs)
+    if uri.startswith("sqlite://"):
+        from .sqlite_store import SqliteStore
+
+        # sqlite:///abs/path.db → "/abs/path.db"; sqlite://rel.db → "rel.db"
+        return SqliteStore(uri[len("sqlite://"):] or "tpu_dpow.db", **kwargs)
     if uri.startswith("redis://"):
         from .redis_store import RedisStore
 

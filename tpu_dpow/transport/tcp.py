@@ -196,7 +196,7 @@ class TcpTransport(Transport):
         For scheme-based dispatch across all wire protocols (tcp/mqtt/ws),
         use ``tpu_dpow.transport.transport_from_uri``.
         """
-        from urllib.parse import urlparse
+        from urllib.parse import unquote, urlparse
 
         u = urlparse(uri)
         if u.scheme not in cls.SCHEMES:
@@ -207,8 +207,10 @@ class TcpTransport(Transport):
         return cls(
             host=u.hostname or "127.0.0.1",
             port=u.port or 1883,
-            username=u.username or "",
-            password=u.password or "",
+            # urlparse leaves userinfo percent-encoded; credentials with
+            # reserved characters (/, ?, @, #) arrive quoted.
+            username=unquote(u.username or ""),
+            password=unquote(u.password or ""),
             **kwargs,
         )
 

@@ -211,7 +211,7 @@ class WsTransport(TcpTransport):
     @classmethod
     def from_uri(cls, uri: str, **kwargs) -> "WsTransport":
         """'ws://user:password@host:port/path' (wss:// for TLS)."""
-        from urllib.parse import urlparse, urlunparse
+        from urllib.parse import unquote, urlparse, urlunparse
 
         u = urlparse(uri)
         if u.scheme not in ("ws", "wss"):
@@ -221,7 +221,8 @@ class WsTransport(TcpTransport):
             netloc += f":{u.port}"
         url = urlunparse((u.scheme, netloc, u.path or "/mqtt", "", u.query, ""))
         return cls(
-            url=url, username=u.username or "", password=u.password or "", **kwargs
+            url=url, username=unquote(u.username or ""),
+            password=unquote(u.password or ""), **kwargs
         )
 
     async def _open(self) -> None:
