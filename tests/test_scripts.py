@@ -254,3 +254,29 @@ def test_latency_probe_over_mqtt_wire():
 
     probe = run(flow())
     assert probe.summary()["cancels"] == 1
+
+
+def test_services_cli_on_sqlite_store(tmp_path):
+    """The admin CLI operates on the server's live sqlite database — the
+    reference's equivalent is redis-cli access to the shared Redis."""
+    from tpu_dpow.scripts import services as svc
+
+    db = f"sqlite://{tmp_path}/state.db"
+    rc = svc.main(["add", "--store", db, "--user", "acme",
+                   "--api_key", "sekrit", "--display", "Acme", "--private"])
+    assert rc == 0
+    rc = svc.main(["check", "--store", db, "--user", "acme"])
+    assert rc == 0
+    rc = svc.main(["check", "--store", db, "--user", "nobody"])
+    assert rc != 0
+
+    async def inspect():
+        from tpu_dpow.store.sqlite_store import SqliteStore
+
+        s = SqliteStore(f"{tmp_path}/state.db")
+        await s.setup()
+        assert await s.smembers("services") == {"acme"}
+        assert (await s.hgetall("service:acme"))["display"] == "Acme"
+        await s.close()
+
+    asyncio.run(inspect())

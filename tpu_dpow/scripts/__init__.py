@@ -21,8 +21,12 @@ from ..store import MemoryStore, Store, get_store
 
 @contextlib.asynccontextmanager
 async def open_store(uri: str) -> AsyncIterator[Store]:
-    """Open a store by URI; checkpoint-file stores persist mutations on exit."""
-    if uri.startswith("redis://") or uri == "memory":
+    """Open a store by URI; checkpoint-file stores persist mutations on exit.
+
+    sqlite:// operates on the server's live database (WAL mode permits the
+    concurrent reader/writer), the reference's redis-cli-style ops access.
+    """
+    if uri.startswith(("redis://", "sqlite://")) or uri == "memory":
         store = get_store(uri)
         await store.setup()
         try:
