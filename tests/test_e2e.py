@@ -362,3 +362,67 @@ def test_e2e_over_mqtt_wire():
             await tcp_server.stop()
 
     run(main())
+
+
+def test_e2e_precache_flood_and_frontier_churn():
+    """Precache at scale: a burst of confirmations across many accounts all
+    land as instant service hits; a frontier advance retires the stale
+    precache (reference dpow_server.py:191-205 semantics) and the retired
+    hash falls back to on-demand."""
+    import secrets as _secrets
+
+    async def main():
+        broker = Broker()
+        runner, server, store, clients = await start_stack(broker, debug=True)
+        try:
+            # 12 distinct accounts confirm one block each in a burst
+            accounts = [nc.encode_account(_secrets.token_bytes(32)) for _ in range(12)]
+            hashes = [random_hash() for _ in range(12)]
+            for h, acct in zip(hashes, accounts):
+                await server.block_arrival_handler(h, acct, None)
+            # frontier churn: account 0 confirms a NEWER block on top of its
+            # frontier -> the old frontier's precache must be retired
+            newer = random_hash()
+            await server.block_arrival_handler(newer, accounts[0], hashes[0])
+            wanted = hashes[1:] + [newer]
+
+            from tpu_dpow.server.app import WORK_PENDING
+
+            async def settled(h):
+                for _ in range(500):
+                    w = await store.get(f"block:{h}")
+                    if w and w != WORK_PENDING:
+                        return w
+                    await asyncio.sleep(0.02)
+                raise AssertionError(f"precache never landed for {h}")
+
+            works = await asyncio.gather(*(settled(h) for h in wanted))
+            for h, w in zip(wanted, works):
+                nc.validate_work(h, w, EASY_BASE)
+            # every request is now an instant precache hit
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                for h, w in zip(wanted, works):
+                    async with http.post(
+                        url, json={"user": "svc", "api_key": "secret", "hash": h}
+                    ) as resp:
+                        body = await resp.json()
+                    assert body.get("work") == w, body
+                hits = await store.hget("service:svc", "precache")
+                assert int(hits) == len(wanted)
+                # the retired frontier is no longer precached: a request for
+                # it is served on demand (fresh work, ondemand counter)
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": hashes[0]}
+                ) as resp:
+                    body = await resp.json()
+                nc.validate_work(hashes[0], body["work"], EASY_BASE)
+                assert int(await store.hget("service:svc", "ondemand") or 0) >= 1
+            # drained: no worker still grinding
+            await asyncio.sleep(0.2)
+            for c in clients:
+                assert not c.work_handler.ongoing
+        finally:
+            await stop_stack(runner, clients)
+
+    run(main())

@@ -277,9 +277,28 @@ class DpowServer:
             ),
         ]
         if old_frontier:
-            aws.append(self.store.delete(f"block:{old_frontier}"))
+            # Retire the superseded frontier completely: its winner lock and
+            # work-type must go with the work, or a later on-demand request
+            # for that hash dispatches fine but every result is discarded at
+            # the still-held setnx lock until its TTL (reference parity:
+            # dpow_server.py:191-205 only deletes the work key, but its lock
+            # has a 5 s TTL and the reference accepts that stall window —
+            # here the retirement is made atomic instead).
+            aws.append(
+                self.store.delete(
+                    f"block:{old_frontier}",
+                    f"block-lock:{old_frontier}",
+                    f"work-type:{old_frontier}",
+                )
+            )
         elif previous_exists:
-            aws.append(self.store.delete(f"block:{previous}"))
+            aws.append(
+                self.store.delete(
+                    f"block:{previous}",
+                    f"block-lock:{previous}",
+                    f"work-type:{previous}",
+                )
+            )
         await asyncio.gather(*aws)
 
     async def block_arrival_ws_handler(self, data: dict) -> None:

@@ -74,6 +74,9 @@ def parse_args(argv=None) -> ServerConfig:
     p.add_argument("--account_expiry", type=float, default=c.account_expiry)
     p.add_argument("--max_multiplier", type=float, default=c.max_multiplier)
     p.add_argument("--throttle", type=float, default=c.throttle)
+    p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
+                   help="seconds between public statistics broadcasts "
+                   "(reference: fixed 300)")
     p.add_argument("--difficulty", type=lambda s: int(s, 16), dest="base_difficulty",
                    default=c.base_difficulty)
     p.add_argument("--log_file", default=None)
