@@ -150,6 +150,24 @@ def test_broker_sheds_load_on_full_queue():
     run(main())
 
 
+def test_clean_session_takeover_stale_detach_keeps_new_session():
+    """A lingering old connection's late detach must not unregister the NEW
+    connection's session (regression: clean-session takeover created a new
+    Session under the same id, and the stale detach popped it — the live
+    client kept its socket but silently stopped receiving)."""
+    broker = Broker()
+    s_old = broker.attach("dup", "u", "")
+    q_old = s_old.queue
+    s_new = broker.attach("dup", "u", "")
+    broker.subscribe(s_new, "work/#", 0)
+    # The old connection's pump finally notices the poison pill / dead
+    # socket and detaches with ITS queue — after the takeover.
+    broker.detach(s_old, q_old)
+    assert broker.sessions.get("dup") is s_new
+    broker.publish(None, "work/ondemand", "FRESH", 0)
+    assert s_new.queue.get_nowait().payload == "FRESH"
+
+
 # -- TCP ---------------------------------------------------------------
 
 

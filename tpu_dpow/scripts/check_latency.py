@@ -54,9 +54,16 @@ class LatencyProbe:
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.MAX_PENDING_AGE
-        for block_hash, start in list(self.work_sent.items()):
-            if start < cutoff:
-                del self.work_sent[block_hash]
+        # Insertion order == ascending start time, so stop at the first
+        # fresh entry: amortized O(1) per message instead of a full scan on
+        # exactly the busy brokers the prune exists for.
+        stale = []
+        for block_hash, start in self.work_sent.items():
+            if start >= cutoff:
+                break
+            stale.append(block_hash)
+        for block_hash in stale:
+            del self.work_sent[block_hash]
 
     def on_message(self, topic: str, payload: str) -> None:
         now = time.monotonic()

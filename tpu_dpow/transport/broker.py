@@ -95,7 +95,10 @@ class Broker:
             # belongs to a newer connection — don't null ITS queue.
             return
         session.queue = None
-        if session.clean:
+        # Only drop the registry entry if it is still THIS session: after a
+        # clean-session takeover the id maps to the new connection's Session,
+        # which must keep receiving messages.
+        if session.clean and self.sessions.get(session.client_id) is session:
             self.sessions.pop(session.client_id, None)
 
     # -- pub/sub -------------------------------------------------------
