@@ -182,6 +182,53 @@ def test_difficulty_zero_pad_rows_cost_nothing_and_report_zero(tpu_device):
     assert all(int(o) == 0 for o in out[1:])  # pads hit instantly
 
 
+def test_sharded_pallas_path_on_device(tpu_device):
+    """The mesh-ganged path (shard_map + per-shard Pallas kernel + pmin
+    election) Mosaic-lowers and solves on the real chip. A (1,1) mesh is
+    topology-trivial but compiles and executes the exact same program the
+    v5e-8 latency gang runs — the CPU-mesh tests and the driver's virtual
+    dryrun only ever see the interpret/XLA lowering of this code."""
+    import jax
+
+    from tpu_dpow.ops import search
+    from tpu_dpow.parallel import (
+        make_mesh, replicate_params, sharded_search_chunk_batch,
+        sharded_search_run,
+    )
+
+    mesh = make_mesh([tpu_device])
+    h = secrets.token_bytes(32)
+    base = secrets.randbits(64)
+    sublanes, iters, nblocks = 32, 256, 4
+    chunk = sublanes * 128 * iters * nblocks
+    # Deterministic: the planted nonce's own work value is the target, so
+    # the window always holds at least one hit (no random-draw flakiness).
+    offset = chunk // 2 + 17
+    diff = _plant(h, (base + offset) & ((1 << 64) - 1))
+    params = np.stack([search.pack_params(h, diff, base)])
+
+    out = sharded_search_chunk_batch(
+        replicate_params(params, mesh),
+        mesh=mesh, chunk_per_shard=chunk, kernel="pallas",
+        sublanes=sublanes, iters=iters, nblocks=nblocks, group=8,
+    )
+    got = int(np.asarray(out)[0])
+    assert got <= offset, "planted hit missed or overshot"
+    nonce = search.nonce_from_offset(base, got)
+    assert _plant(h, nonce) >= diff
+
+    # The device-resident multi-step gang (while_loop over ganged windows).
+    lo, hi = sharded_search_run(
+        replicate_params(params, mesh),
+        jax.numpy.asarray([True]),
+        mesh=mesh, chunk_per_shard=chunk, max_steps=4, kernel="pallas",
+        sublanes=sublanes, iters=iters, nblocks=nblocks, group=8,
+    )
+    nonce = (int(np.asarray(hi)[0]) << 32) | int(np.asarray(lo)[0])
+    assert nonce != (1 << 64) - 1, "run-mode gang found nothing in 16.7M nonces"
+    assert _plant(h, nonce) >= diff
+
+
 def test_backend_run_mode_and_warm_shapes_on_device():
     """The production defaults (widened runs + two-shape warming) through
     generate(): singles and a batch burst, all hashlib-valid."""
