@@ -280,3 +280,151 @@ def test_client_stats_and_malformed_messages():
             await wait_until(lambda: h in hx.backend.futures)
 
     run(main())
+
+
+class RaisingBackend(ManualBackend):
+    """ManualBackend + the jax/native retarget contract: raise_difficulty
+    retargets a RUNNING job in place."""
+
+    def __init__(self):
+        super().__init__()
+        self.targets = {}
+
+    async def generate(self, request):
+        self.targets[request.block_hash] = request.difficulty
+        return await super().generate(request)
+
+    async def raise_difficulty(self, block_hash, difficulty):
+        fut = self.futures.get(block_hash)
+        if fut is None or fut.done():
+            return False
+        self.targets[block_hash] = max(self.targets[block_hash], difficulty)
+        return True
+
+
+def test_handler_duplicate_with_higher_difficulty_raises_ongoing_target():
+    """A work re-dispatch at a raised difficulty (precache hash re-requested
+    on-demand at a higher multiplier) must reach the running backend job —
+    dropping it as a dup leaves the job solving at the stale target and the
+    result rejected server-side (regression)."""
+
+    async def main():
+        backend = RaisingBackend()
+        results = []
+
+        async def cb(req, work):
+            results.append((req.difficulty, work))
+
+        handler = WorkHandler(backend, cb, concurrency=2)
+        await handler.start()
+        h = random_hash()
+        hard = EASY | (0xF << 56)
+        await handler.queue_work(WorkRequest(h, EASY))
+        await wait_until(lambda: h in backend.futures)
+        await handler.queue_work(WorkRequest(h, hard))
+        await wait_until(lambda: backend.targets[h] == hard)
+        backend.solve(h, "beef")
+        await wait_until(lambda: results)
+        # reported once, carrying the RAISED request
+        assert results == [(hard, "beef")]
+        # a weaker/equal duplicate is still just deduped
+        h2 = random_hash()
+        await handler.queue_work(WorkRequest(h2, hard))
+        await wait_until(lambda: h2 in backend.futures)
+        await handler.queue_work(WorkRequest(h2, EASY))
+        assert backend.targets[h2] == hard
+        await handler.stop()
+
+    run(main())
+
+
+def test_handler_duplicate_with_higher_difficulty_updates_queued_entry():
+    async def main():
+        backend = RaisingBackend()
+
+        async def cb(req, work):
+            pass
+
+        handler = WorkHandler(backend, cb, concurrency=1)
+        await handler.start()
+        h1, h2 = random_hash(), random_hash()
+        hard = EASY | (0xF << 56)
+        await handler.queue_work(WorkRequest(h1, EASY))
+        await wait_until(lambda: h1 in backend.futures)
+        await handler.queue_work(WorkRequest(h2, EASY))   # stays queued
+        await handler.queue_work(WorkRequest(h2, hard))   # raises queued entry
+        assert handler.queue.get(h2).difficulty == hard
+        backend.solve(h1)
+        await wait_until(lambda: h2 in backend.futures)
+        assert backend.targets[h2] == hard  # popped at the raised target
+        await handler.stop()
+
+    run(main())
+
+
+def test_client_reconnects_when_message_stream_ends():
+    """A transport whose message stream ends (retries exhausted, broker
+    restart) must trigger the reconnect path, not hang on the still-running
+    heartbeat watchdog (regression: zombie worker)."""
+
+    async def main():
+        async with ClientHarness() as hx:
+            hx.client.config.reconnect_delay = 0.05
+            setups = 0
+            real_setup = hx.client.setup
+
+            async def counting_setup():
+                nonlocal setups
+                setups += 1
+                await real_setup()
+
+            hx.client.setup = counting_setup
+            run_task = asyncio.ensure_future(hx.client.run())
+            await wait_until(lambda: setups == 1 and hx.client._tasks)
+            # sever the connection out from under the message loop
+            await hx.client.transport.close()
+            await wait_until(lambda: setups >= 2)  # reconnected
+            # and the rebuilt connection actually works
+            h = random_hash()
+            await wait_until(lambda: hx.client.work_handler._started)
+            await hx.server_t.publish("work/ondemand", f"{h},{EASY:016x}")
+            await wait_until(lambda: h in hx.backend.futures)
+            run_task.cancel()
+            try:
+                await run_task
+            except asyncio.CancelledError:
+                pass
+
+    run(main())
+
+
+def test_handler_raise_falls_back_to_cancel_and_requeue():
+    """An engine that cannot retarget (external nano-work-server contract:
+    raise_difficulty returns False) must get cancel + re-enqueue at the
+    raised target, not a silently-dropped raise."""
+
+    async def main():
+        backend = ManualBackend()  # no raise support → default False
+        results = []
+
+        async def cb(req, work):
+            results.append((req.difficulty, work))
+
+        handler = WorkHandler(backend, cb, concurrency=2)
+        await handler.start()
+        h = random_hash()
+        hard = EASY | (0xF << 56)
+        await handler.queue_work(WorkRequest(h, EASY))
+        await wait_until(lambda: h in backend.futures)
+        await handler.queue_work(WorkRequest(h, hard))
+        # old job cancelled, replacement picked up at the raised target
+        assert backend.cancelled == [h]
+        await wait_until(
+            lambda: h in backend.futures and not backend.futures[h].done()
+        )
+        backend.solve(h, "beef")
+        await wait_until(lambda: results)
+        assert results == [(hard, "beef")]
+        await handler.stop()
+
+    run(main())

@@ -454,3 +454,32 @@ def test_checkpoint_restore_roundtrip(tmp_path):
             assert await hx2.store.get(f"block:{h}") == resp["work"]
 
     run(main())
+
+
+def test_stale_raised_difficulty_cleared_on_base_redispatch():
+    """A raised-difficulty dispatch that timed out must not poison a later
+    base-difficulty request for the same hash: the leftover
+    block-difficulty entry (120 s TTL) would make the result handler
+    validate base-difficulty work against the old higher target and
+    discard it (regression)."""
+
+    async def main():
+        async with Harness() as hx:
+            h = random_hash()
+            # raised request with no workers: times out, leaves its entry
+            with pytest.raises(RequestTimeout):
+                await hx.server.service_handler(
+                    hx.request(h, multiplier=4.0, timeout=1)
+                )
+            raised = nc.derive_work_difficulty(4.0, EASY_BASE)
+            assert await hx.store.get(f"block-difficulty:{h}") == f"{raised:016x}"
+            # base request for the same hash with a worker now present
+            await hx.start_worker()
+            resp = await hx.server.service_handler(hx.request(h, timeout=5))
+            nc.validate_work(h, resp["work"], EASY_BASE)
+            # the stale entry is gone, and the dispatch went out at base
+            assert await hx.store.get(f"block-difficulty:{h}") is None
+            msg = next(m for m in hx.worker_log if m.topic == "work/ondemand")
+            assert msg.payload.split(",")[1] == f"{EASY_BASE:016x}"
+
+    run(main())

@@ -129,6 +129,74 @@ def test_inproc_acl_matrix():
     run(main())
 
 
+def test_acl_rejects_patterns_broader_than_grant():
+    """A subscription pattern BROADER than the grant must be denied:
+    matching patterns against each other admitted '#' because it "matches"
+    'work/#' (regression — the whole ACL matrix was advisory)."""
+    from tpu_dpow.transport import User, pattern_covers
+
+    u = User(password="", acl_sub=("work/#", "cancel/+", "heartbeat"))
+    assert u.may_subscribe("work/#")
+    assert u.may_subscribe("work/ondemand")
+    assert u.may_subscribe("cancel/+")
+    assert u.may_subscribe("cancel/ondemand")
+    assert u.may_subscribe("heartbeat")
+    assert not u.may_subscribe("#")           # the bypass
+    assert not u.may_subscribe("+")
+    assert not u.may_subscribe("result/#")
+    assert not u.may_subscribe("cancel/#")    # '+' grant does not cover '#'
+    assert not u.may_subscribe("+/ondemand")  # literal grant vs '+' pattern
+    # pattern_covers ground truths
+    assert pattern_covers("#", "anything/at/all")
+    assert pattern_covers("work/#", "work")       # MQTT: work/# matches work
+    assert not pattern_covers("work", "work/#")
+    assert pattern_covers("+/x", "a/x")
+    assert not pattern_covers("a/x", "+/x")
+
+
+def test_acl_enforced_at_delivery_too():
+    """Even with a too-broad subscription somehow in place (resumed session,
+    ACL change), messages outside the user's read grants must not be
+    delivered (mosquitto checks per delivered message)."""
+
+    async def main():
+        broker = Broker(users=default_users())
+        spy = InProcTransport(broker, username="client", password="client")
+        await spy.connect()
+        # plant an over-broad subscription directly (bypassing may_subscribe,
+        # as a session resumed from an older ACL regime would)
+        spy._session.subscriptions["#"] = 0
+        server = InProcTransport(broker, username="dpowserver", password="dpowserver")
+        await server.connect()
+        await server.subscribe("result/#")
+        await spy.publish("result/ondemand", "h,w,addr")  # clients may publish results
+        got = await _collect(server, 1)
+        assert got[0].payload == "h,w,addr"
+        # the spy's own result subscription must yield nothing
+        assert spy._queue.empty()
+        assert broker.stats["denied"] >= 1
+        await spy.close(); await server.close()
+
+    run(main())
+
+
+def test_persistent_session_not_inherited_across_users():
+    """A durable session's subscriptions/offline queue must not transfer to
+    a DIFFERENT user presenting the same client_id (regression: attach
+    reused the Session and rebound username without re-checking ACLs)."""
+    broker = Broker(users=default_users())
+    s1 = broker.attach("shared-id", "dpowserver", "dpowserver", clean_session=False)
+    broker.subscribe(s1, "result/#", 1)
+    broker.detach(s1)
+    # offline QoS-1 message queues for dpowserver's durable session
+    pub = broker.attach("pub", "client", "client")
+    broker.publish(pub, "result/ondemand", "secret", 1)
+    # a different (read-only) user resumes the same client_id
+    s2 = broker.attach("shared-id", "dpowinterface", "dpowinterface", clean_session=False)
+    assert s2.subscriptions == {}  # nothing inherited
+    assert s2.queue.empty()        # no replayed foreign offline messages
+
+
 def test_broker_sheds_load_on_full_queue():
     async def main():
         from tpu_dpow.transport import broker as broker_mod
@@ -404,5 +472,59 @@ def test_second_connect_on_same_socket_rejected():
         assert "dup2" not in broker.sessions  # no leaked session
         writer.close()
         await server.stop()
+
+    run(main())
+
+
+def test_tcp_overlong_line_gets_protocol_error():
+    """A frame beyond MAX_LINE must be answered with the documented
+    {"op":"error","reason":"line too long"} reply — not torn down by
+    StreamReader's ValueError before the check can fire (regression)."""
+    import json as _json
+
+    from tpu_dpow.transport.tcp import MAX_LINE
+
+    async def main():
+        broker = Broker()
+        srv = TcpBrokerServer(broker, port=0)
+        await srv.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", srv.port, limit=2 * MAX_LINE
+            )
+            big = _json.dumps({"op": "pub", "topic": "t", "payload": "x" * (MAX_LINE + 100)})
+            writer.write(big.encode() + b"\n")
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), 5)
+            reply = _json.loads(line)
+            assert reply == {"op": "error", "reason": "line too long"}
+            writer.close()
+        finally:
+            await srv.stop()
+
+    run(main())
+
+
+def test_tcp_hugely_overlong_line_still_answered():
+    """Even past the raised stream limit (ValueError path) the same
+    protocol error comes back before the connection closes."""
+    import json as _json
+
+    from tpu_dpow.transport.tcp import MAX_LINE
+
+    async def main():
+        broker = Broker()
+        srv = TcpBrokerServer(broker, port=0)
+        await srv.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+            writer.write(b"{" + b"x" * (4 * MAX_LINE) + b"\n")
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), 5)
+            reply = _json.loads(line)
+            assert reply == {"op": "error", "reason": "line too long"}
+            writer.close()
+        finally:
+            await srv.stop()
 
     run(main())

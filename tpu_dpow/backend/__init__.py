@@ -76,6 +76,19 @@ class WorkBackend(abc.ABC):
     async def cancel(self, block_hash: str) -> None:
         """Abort an in-flight generate for this hash (idempotent)."""
 
+    async def raise_difficulty(self, block_hash: str, difficulty: int) -> bool:
+        """Raise a RUNNING job's target in place; True if it took effect.
+
+        The server re-dispatches a hash at a higher difficulty when a
+        precached block is requested on-demand at a raised multiplier;
+        engines that share one search job per hash (jax, native) retarget
+        it mid-flight — the eventual nonce then satisfies the raise without
+        restarting the scan. The default says "can't" (False): the caller
+        must then fall back to cancel + re-generate (the only contract an
+        external nano-work-server offers).
+        """
+        return False
+
     async def close(self) -> None:  # pragma: no cover - trivial default
         return None
 

@@ -247,6 +247,17 @@ class JaxWorkBackend(WorkBackend):
             job.cancelled = True
             job.future.set_exception(WorkCancelled(job.block_hash))
 
+    async def raise_difficulty(self, block_hash: str, difficulty: int) -> bool:
+        """Retarget a running job in place; the engine loop's per-launch
+        difficulty snapshot keeps an in-flight chunk's weaker hit searching
+        on past it at the new target."""
+        job = self._jobs.get(nc.validate_block_hash(block_hash))
+        if job is None or job.cancelled or job.future.done():
+            return False
+        if difficulty > job.difficulty:
+            job.set_difficulty(difficulty)
+        return True
+
     async def close(self) -> None:
         self._closed = True
         if self._warm_task is not None:

@@ -314,6 +314,15 @@ class NativeWorkBackend(WorkBackend):
             job.cancel_flag.value = 1
             job.future.set_exception(WorkCancelled(block_hash))
 
+    async def raise_difficulty(self, block_hash: str, difficulty: int) -> bool:
+        """Retarget a running job; the scan re-reads the target each chunk."""
+        job = self._jobs.get(nc.validate_block_hash(block_hash))
+        if job is None or job.future.done():
+            return False
+        if difficulty > job.difficulty:
+            job.difficulty = difficulty
+        return True
+
     async def close(self) -> None:
         self._closed = True
         for key, job in list(self._jobs.items()):

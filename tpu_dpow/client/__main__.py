@@ -22,11 +22,21 @@ async def amain(argv=None) -> None:
     from ..utils import maybe_init_distributed
 
     maybe_init_distributed()
+    import socket
+
     config = parse_args(argv)
     get_logger("tpu_dpow.client", file_path=config.log_file)
+    # client_id must be stable across restarts (durable session: offline
+    # QoS-1 cancel/client replay) but UNIQUE per worker — payout address
+    # alone collides when a fleet shares one payout, and the broker's
+    # session takeover would then silently mute all but the newest worker.
+    # Default adds the hostname; several workers on ONE machine need an
+    # explicit --client_id each.
+    host_tag = socket.gethostname().replace("/", "-")[:24] or "host"
+    client_id = config.client_id or f"client-{config.payout_address[-8:]}-{host_tag}"
     transport = transport_from_uri(
         config.server_uri,
-        client_id=f"client-{config.payout_address[-8:]}",
+        client_id=client_id,
         clean_session=False,
     )
     client = DpowClient(config, transport)

@@ -183,15 +183,40 @@ class DpowClient:
 
     async def run(self) -> None:
         """Full lifecycle incl. error→sleep→reconnect (reference :156-197)."""
+        first = True
         while True:
             try:
+                # Startup gate: the FIRST setup() failure (no broker, no
+                # heartbeat) fails fast — don't retry-loop a misconfig.
+                # Re-setups after a lost connection retry like any outage.
                 await self.setup()
-                self.start_loops()
-                await asyncio.gather(*self._tasks)
             except asyncio.CancelledError:
                 raise
-            except ConnectionError:
-                raise  # startup gate: fail fast, do not retry-loop
+            except Exception:
+                if first:
+                    raise
+                logger.error("reconnect setup failed; retrying in %.0fs:\n%s",
+                             self.config.reconnect_delay, traceback.format_exc())
+                await self.close()
+                await asyncio.sleep(self.config.reconnect_delay)
+                continue
+            first = False
+            try:
+                self.start_loops()
+                # FIRST_COMPLETED, not gather: the heartbeat watchdog runs
+                # forever, so gathering would hang after _message_loop ends
+                # cleanly (transport retries exhausted → iterator closes) —
+                # a zombie worker that never reconnects. Any loop finishing
+                # means the connection is gone; once up, every failure mode
+                # reconnects rather than exiting.
+                done, _ = await asyncio.wait(
+                    self._tasks, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    t.result()  # surface a crashed loop's exception
+                raise RuntimeError("transport message stream ended")
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.error("client crashed; reconnecting in %.0fs:\n%s",
                              self.config.reconnect_delay, traceback.format_exc())

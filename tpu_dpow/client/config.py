@@ -24,6 +24,7 @@ class ClientConfig:
     mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
     work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
+    client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
     log_file: Optional[str] = None
 
     def __post_init__(self):
@@ -62,6 +63,11 @@ def parse_args(argv=None) -> ClientConfig:
     p.add_argument("--work_concurrency", type=int, default=c.work_concurrency,
                    help="work items in flight at once (0 = auto: 2*max_batch "
                    "for the jax backend, 8 otherwise)")
+    p.add_argument("--client_id", default=c.client_id,
+                   help="broker session id; must be unique per worker process "
+                   "(default payout+hostname — set explicitly when running "
+                   "several workers on one machine, or they take over each "
+                   "other's session)")
     p.add_argument("--log_file", default=None)
     ns = p.parse_args(argv)
     return ClientConfig(**vars(ns))

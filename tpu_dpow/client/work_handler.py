@@ -59,6 +59,20 @@ class WorkQueue:
                 return True
         return False
 
+    def get(self, block_hash: str) -> Optional[WorkRequest]:
+        for r in self._items:
+            if r.block_hash == block_hash:
+                return r
+        return None
+
+    def replace(self, request: WorkRequest) -> bool:
+        """Swap the queued entry for this hash in place (same queue slot)."""
+        for i, r in enumerate(self._items):
+            if r.block_hash == request.block_hash:
+                self._items[i] = request
+                return True
+        return False
+
     async def pop_random(self) -> WorkRequest:
         while not self._items:
             self._waiter.clear()
@@ -104,9 +118,41 @@ class WorkHandler:
         await self.backend.close()
 
     async def queue_work(self, request: WorkRequest) -> None:
-        """Enqueue unless already queued or ongoing (reference :83-94)."""
+        """Enqueue unless already queued or ongoing (reference :83-94).
+
+        A duplicate carrying a HIGHER difficulty is not just noise — it is
+        the server re-dispatching a precached hash on-demand at a raised
+        multiplier (server/app.py _dispatch_ondemand). Dropping it would
+        leave the running job solving at the old target and the eventual
+        result rejected server-side; instead the raise is threaded through:
+        a queued entry is swapped for the harder request; an ongoing one is
+        retargeted in place via backend.raise_difficulty, falling back to
+        cancel + re-enqueue for engines that cannot retarget (external
+        nano-work-server; a job that just finished at the weak target).
+        """
         bh = request.block_hash
-        if bh in self.queue or bh in self.ongoing:
+        ongoing = self.ongoing.get(bh)
+        if ongoing is not None:
+            if request.difficulty > ongoing.difficulty:
+                if await self.backend.raise_difficulty(bh, request.difficulty):
+                    # The await may have yielded; only relabel if the SAME
+                    # entry is still ongoing — writing after the worker loop
+                    # popped it would plant a ghost entry that dedups this
+                    # hash forever.
+                    if self.ongoing.get(bh) is ongoing:
+                        self.ongoing[bh] = request  # report under the raise
+                else:
+                    await self.queue_cancel(bh)
+                    self.queue.put(request)
+                    self.stats["queued"] += 1
+                    return
+            self.stats["deduped"] += 1
+            return
+        queued = self.queue.get(bh)
+        if queued is not None:
+            if request.difficulty > queued.difficulty:
+                self.queue.replace(request)
+                logger.debug("raised queued difficulty for %s", bh)
             self.stats["deduped"] += 1
             return
         self.queue.put(request)
@@ -151,12 +197,16 @@ class WorkHandler:
                 self.stats["errors"] += 1
                 logger.error("unexpected backend failure:\n%s", traceback.format_exc())
                 continue
-            # Completion/cancel race: only report if still ongoing.
-            if self.ongoing.pop(bh, None) is None:
+            # Completion/cancel race: only report if still ongoing. The
+            # popped entry, not the popped-at-dispatch `request`, is what
+            # gets reported — a duplicate may have raised its difficulty
+            # while the job was in flight.
+            current = self.ongoing.pop(bh, None)
+            if current is None:
                 logger.debug("work %s completed after cancel; dropped", bh)
                 continue
             self.stats["solved"] += 1
             try:
-                await self.result_callback(request, work)
+                await self.result_callback(current, work)
             except Exception:
                 logger.error("result callback failed:\n%s", traceback.format_exc())

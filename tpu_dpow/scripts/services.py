@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import hashlib
 import json
 import secrets
 import sys
@@ -20,9 +19,10 @@ from . import open_store
 
 SERVICE_FIELDS = ("display", "website", "public")
 
-
-def hash_api_key(api_key: str) -> str:
-    return hashlib.blake2b(api_key.encode()).hexdigest()
+# THE api_key hash: the CLI writes records the server verifies, so both
+# sides must share one implementation — any drift (digest size, salt,
+# encoding) would lock every service out with "Invalid credentials".
+from ..server.app import hash_key as hash_api_key  # noqa: E402
 
 
 async def add(store, args) -> int:

@@ -456,6 +456,13 @@ class DpowServer:
                     f"{difficulty:016x}",
                     expire=self.config.difficulty_expiry,
                 )
+            else:
+                # A previous raised-difficulty dispatch for this hash may
+                # have timed out inside the 120 s TTL; its leftover entry
+                # would make the result handler validate THIS base-difficulty
+                # dispatch against the old higher target and discard valid
+                # work. Clear it so validation matches what was asked for.
+                await self.store.delete(f"block-difficulty:{block_hash}")
             self.work_futures[block_hash] = asyncio.get_running_loop().create_future()
             await self.transport.publish(
                 "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0

@@ -60,6 +60,38 @@ def topic_matches(pattern: str, topic: str) -> bool:
     return len(p_levels) == len(t_levels)
 
 
+def pattern_covers(grant: str, pattern: str) -> bool:
+    """True iff every topic matching ``pattern`` also matches ``grant``.
+
+    The subscription-ACL question: may a user whose grant is ``grant``
+    subscribe ``pattern``? Decidable segment-wise for MQTT wildcards —
+    unlike matching the two patterns against each other, which wrongly
+    admits a pattern BROADER than the grant (e.g. '#' "matches" 'work/#').
+    """
+    g = grant.split("/")
+    s = pattern.split("/")
+    i = 0
+    while True:
+        g_tok = g[i] if i < len(g) else None
+        s_tok = s[i] if i < len(s) else None
+        if g_tok == "#":
+            return True  # grant covers the whole remaining subtree
+        if g_tok is None and s_tok is None:
+            return True  # both exhausted: identical depth, all covered
+        if s_tok == "#":
+            return False  # pattern wants a subtree the grant doesn't give
+        if g_tok is None or s_tok is None:
+            return False  # depth mismatch without a '#' to absorb it
+        if g_tok == "+":
+            i += 1  # any single segment is covered
+            continue
+        if s_tok == "+":
+            return False  # pattern matches any segment; grant is literal
+        if g_tok != s_tok:
+            return False
+        i += 1
+
+
 class Transport(abc.ABC):
     """One endpoint's connection to the broker."""
 
@@ -98,15 +130,16 @@ class User:
         return any(topic_matches(p, topic) for p in self.acl_pub)
 
     def may_subscribe(self, pattern: str) -> bool:
-        # A subscription is allowed if it is no broader than some ACL grant:
-        # exact containment is undecidable cheaply, so (like mosquitto) we
-        # check the pattern itself against the grants treating the
-        # subscription as a topic with wildcards intact, plus the common
-        # case of subscribing exactly an allowed pattern.
-        return any(
-            p == pattern or topic_matches(p, pattern) or topic_matches(pattern, p)
-            for p in self.acl_sub
-        )
+        # Allowed iff the requested pattern is no broader than some grant
+        # (true containment — matching the patterns against each other
+        # would admit e.g. '#' because it "matches" the grant 'work/#').
+        return any(pattern_covers(p, pattern) for p in self.acl_sub)
+
+    def may_receive(self, topic: str) -> bool:
+        """Delivery-time read check (mosquitto enforces ACLs per delivered
+        message too — belt for subscriptions that predate an ACL change or
+        rode in on a resumed session)."""
+        return any(topic_matches(p, topic) for p in self.acl_sub)
 
 
 def transport_from_uri(uri: str, **kwargs) -> "Transport":

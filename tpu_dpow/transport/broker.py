@@ -78,7 +78,15 @@ class Broker:
                 session.queue.put_nowait(None)
             except asyncio.QueueFull:
                 pass
-        if session is None or clean_session or session.clean:
+        if (
+            session is None
+            or clean_session
+            or session.clean
+            or session.username != username
+        ):
+            # Fresh state also when a DIFFERENT user presents this client_id:
+            # a durable session's subscriptions and offline queue must never
+            # transfer across accounts (they were ACL-checked as the old user).
             session = Session(client_id=client_id, username=username, clean=clean_session)
             self.sessions[client_id] = session
         session.username = username
@@ -125,6 +133,12 @@ class Broker:
         for target in list(self.sessions.values()):
             sub_qos = target.matches(topic)
             if sub_qos is None:
+                continue
+            if self.users is not None and not self.user_for(target).may_receive(topic):
+                # Per-message read ACL, as mosquitto enforces it: a
+                # subscription that slipped past (or predates) the
+                # subscribe-time check still never leaks messages.
+                self.stats["denied"] += 1
                 continue
             # Effective QoS = min(publish qos, subscription qos), per MQTT.
             eff = min(qos, sub_qos)

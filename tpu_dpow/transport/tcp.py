@@ -54,7 +54,13 @@ class TcpBrokerServer:
         self._conns: set = set()
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        # limit > MAX_LINE: readline() must be able to RETURN an overlong
+        # line so the explicit length check can answer with the protocol
+        # error — at the default 64 KiB limit readline raises ValueError
+        # first and the documented "line too long" reply never happens.
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, limit=2 * MAX_LINE
+        )
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]  # resolve port 0 → actual
         logger.info("broker listening on %s:%s", self.host, self.port)
@@ -94,7 +100,13 @@ class TcpBrokerServer:
                 return
             pending = first
             while True:
-                tail = await reader.readline()
+                try:
+                    tail = await reader.readline()
+                except ValueError:
+                    # Line beyond even the raised stream limit (2*MAX_LINE):
+                    # same protocol answer as the explicit check below.
+                    send({"op": "error", "reason": "line too long"})
+                    break
                 line = pending + tail
                 pending = b""
                 if not line:
@@ -243,7 +255,11 @@ class TcpTransport(Transport):
 
     async def _open(self) -> None:
         """Open the raw connection (overridden by the websocket client)."""
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        # Same raised limit as the server face: a large server frame (e.g.
+        # a statistics broadcast) must not kill the stream with ValueError.
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, limit=2 * MAX_LINE
+        )
 
     async def _connect_once(self) -> None:
         await self._open()
@@ -294,10 +310,12 @@ class TcpTransport(Transport):
         while not self._closed:
             try:
                 frame = await self._read_frame()
-            except (ConnectionError, EOFError, json.JSONDecodeError):
+            except (ConnectionError, EOFError, ValueError):
                 # EOFError covers asyncio.IncompleteReadError: a connection
                 # cut mid-frame (JSON or MQTT) must reconnect, not kill the
-                # rx task and strand messages() forever.
+                # rx task and strand messages() forever. ValueError covers
+                # both json.JSONDecodeError (its subclass) and readline()'s
+                # LimitOverrunError path on an overlong server frame.
                 frame = None
             if frame is None:
                 self._drop_socket()
