@@ -398,3 +398,72 @@ def test_server_mid_wraps_past_16_bits():
         mid = next(out_mid) % 65000 + 1
         raw = mc.encode(mc.Publish(topic="t", payload=b"", qos=1, mid=mid))
         assert 1 <= mc.decode(raw[0], raw[2:]).mid <= 65000
+
+
+def test_codec_fuzz_only_raises_codec_errors():
+    """decode() over random and mutated-valid bodies must yield a packet or
+    MqttCodecError — never any other exception class (UnicodeDecodeError,
+    IndexError, struct.error...), which would escape the faces' error
+    handling and kill connection tasks uncleanly."""
+    import random
+
+    rng = random.Random(0xD1F)
+    valid = [
+        mc.encode(mc.Connect(client_id="fuzz", username="u", password="p",
+                             clean_session=True, keepalive=30)),
+        mc.encode(mc.Publish(topic="work/ondemand", payload=b"H,fff", qos=1, mid=7)),
+        mc.encode(mc.Subscribe(mid=3, topics=[("work/#", 0), ("cancel/+", 1)])),
+        mc.encode(mc.Unsubscribe(mid=4, topics=["work/#"])),
+        mc.encode(mc.Puback(mid=9)),
+    ]
+    cases = []
+    for _ in range(400):  # pure noise
+        n = rng.randrange(0, 64)
+        cases.append((rng.randrange(256), bytes(rng.randrange(256) for _ in range(n))))
+    for pkt in valid:  # mutations of valid packets (skip the varint header)
+        first, body = pkt[0], bytes(pkt[2:])
+        for _ in range(200):
+            b = bytearray(body)
+            for _ in range(rng.randrange(1, 4)):
+                if b:
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+            cases.append((first, bytes(b)))
+        cases.append((first, body[: rng.randrange(len(body) + 1)]))  # truncation
+    decoded = errors = 0
+    for first, body in cases:
+        try:
+            mc.decode(first, body)
+            decoded += 1
+        except mc.MqttCodecError:
+            errors += 1
+    assert decoded + errors == len(cases)  # nothing else escaped
+    assert errors > 0 and decoded > 0     # fuzz actually hit both paths
+
+
+def test_broker_face_survives_garbage_connections():
+    """Raw garbage on the wire must drop that connection only — the broker
+    stays up and serves a well-behaved MQTT client afterwards."""
+
+    async def main():
+        srv = await _start_broker()
+        try:
+            for first in (b"\x10", b"\x30", b"\x82", b"\xf0", b"\x00"):
+                reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+                writer.write(first + b"\xff\xff\xff\xff" + bytes(64))
+                await writer.drain()
+                writer.write_eof()  # JSON-lines face waits for a newline/EOF
+                try:
+                    await asyncio.wait_for(reader.read(-1), 5)  # server closes
+                finally:
+                    writer.close()
+            good = MqttTransport(port=srv.port, client_id="after-fuzz")
+            await good.connect()
+            await good.subscribe("work/#", 0)
+            await good.publish("work/ondemand", "H,fff", 0)
+            msg = await asyncio.wait_for(anext(aiter(good.messages())), 5)
+            assert msg.payload == "H,fff"
+            await good.close()
+        finally:
+            await srv.stop()
+
+    run(main())

@@ -216,6 +216,11 @@ class DpowClient:
                     t.result()  # surface a crashed loop's exception
                 raise RuntimeError("transport message stream ended")
             except asyncio.CancelledError:
+                # gather() cancelled its children on outer cancel; wait()
+                # does not — tear the loops down so a cancelled run() does
+                # not leave a headless client mining in the background.
+                for t in self._tasks:
+                    t.cancel()
                 raise
             except Exception:
                 logger.error("client crashed; reconnecting in %.0fs:\n%s",

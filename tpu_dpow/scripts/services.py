@@ -22,7 +22,7 @@ SERVICE_FIELDS = ("display", "website", "public")
 # THE api_key hash: the CLI writes records the server verifies, so both
 # sides must share one implementation — any drift (digest size, salt,
 # encoding) would lock every service out with "Invalid credentials".
-from ..server.app import hash_key as hash_api_key  # noqa: E402
+from ..utils import hash_key as hash_api_key  # noqa: E402
 
 
 async def add(store, args) -> int:

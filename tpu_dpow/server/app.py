@@ -24,7 +24,6 @@ Responsibilities and their reference anchors:
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import json
 import time
 import traceback
@@ -44,11 +43,9 @@ logger = get_logger("tpu_dpow.server")
 WORK_PENDING = "0"
 
 
-def hash_key(api_key: str) -> str:
-    """Service api_key hashing (parity: reference scripts/services.py:27-30)."""
-    m = hashlib.blake2b()
-    m.update(api_key.encode())
-    return m.hexdigest()
+# Re-exported for compat; the shared implementation lives in utils so the
+# ops CLI does not couple to the server app's import graph.
+from ..utils import hash_key  # noqa: E402, F401
 
 
 class DpowServer:

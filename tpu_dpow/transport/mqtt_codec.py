@@ -85,7 +85,13 @@ class _Reader:
         return int.from_bytes(self.take(2), "big")
 
     def string(self) -> str:
-        return self.take(self.u16()).decode("utf-8")
+        try:
+            return self.take(self.u16()).decode("utf-8")
+        except UnicodeDecodeError as e:
+            # Malformed wire input must surface as a codec error the faces
+            # catch (clean CONNACK/refusal), never an unexpected exception
+            # class out of the connection handler.
+            raise MqttCodecError(f"invalid utf-8 in string: {e}") from e
 
     def rest(self) -> bytes:
         out = self.data[self.pos :]

@@ -1,6 +1,20 @@
 from . import nanocrypto  # noqa: F401
 
 
+def hash_key(api_key: str) -> str:
+    """Service api_key hashing (parity: reference scripts/services.py:27-30).
+
+    THE shared implementation: the admin CLI writes records the server
+    verifies, so both import this one function — any drift (digest size,
+    salt, encoding) would lock every service out with 'Invalid credentials'.
+    """
+    import hashlib
+
+    m = hashlib.blake2b()
+    m.update(api_key.encode())
+    return m.hexdigest()
+
+
 def honor_jax_platforms_env() -> None:
     """Make JAX_PLATFORMS effective even when a site hook pre-registers an
     accelerator backend.
