@@ -288,3 +288,82 @@ def test_sqlite_incrby_preserves_ttl(tmp_path):
         await s.close()
 
     asyncio.run(main())
+
+
+def test_save_is_atomic_against_crash_mid_write(tmp_path, monkeypatch):
+    """A crash (or ENOSPC) during the periodic checkpoint must never
+    truncate the previous durable copy (regression: open('w') emptied the
+    file before the snapshot was written)."""
+    import os
+
+    from tpu_dpow.store import MemoryStore
+
+    async def main():
+        path = str(tmp_path / "ck.json")
+        s = MemoryStore()
+        await s.set("block:AA", "0")
+        s.save(path)
+        good = open(path).read()
+
+        real_replace = os.replace
+
+        def exploding_replace(src, dst):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(os, "replace", exploding_replace)
+        await s.set("block:BB", "0")
+        try:
+            s.save(path)
+        except OSError:
+            pass
+        monkeypatch.setattr(os, "replace", real_replace)
+        # the old checkpoint survived the failed save intact
+        assert open(path).read() == good
+        s2 = MemoryStore()
+        s2.load(path)
+
+    run(main())
+
+
+def test_restore_replaces_rather_than_merges():
+    """restore() makes the store exactly the snapshot: keys absent from the
+    snapshot are gone, and a restored-persistent key sheds any stale TTL."""
+    from tpu_dpow.store import MemoryStore
+
+    async def main():
+        clock = Clock()
+        s = MemoryStore(clock=clock)
+        await s.set("keep", "1")
+        blob = s.snapshot()
+        await s.set("extra", "2")
+        await s.set("keep", "1", expire=5.0)  # stale TTL to shed
+        s.restore(blob)
+        assert await s.get("extra") is None
+        clock.now += 60.0
+        assert await s.get("keep") == "1"  # persistent again, no stale expiry
+
+    run(main())
+
+
+def test_sqlite_exists_and_type_check_cover_all_kinds(tmp_path):
+    """exists() sees hash/set keys (Redis parity) and an expired-but-unswept
+    string row neither blocks retyping nor counts as existing."""
+    from tpu_dpow.store.sqlite_store import SqliteStore
+
+    async def main():
+        s = SqliteStore(str(tmp_path / "s.db"))
+        await s.setup()
+        await s.hset("client:addr", {"ondemand": "1"})
+        await s.sadd("services", "svc")
+        assert await s.exists("client:addr")
+        assert await s.exists("services")
+        assert not await s.exists("nope")
+        # expired string row: invisible to exists() and to the type check
+        await s.set("block:AA", "0", expire=0.01)
+        await asyncio.sleep(0.05)
+        assert not await s.exists("block:AA")
+        await s.hset("block:AA", {"now": "a hash"})  # must not TypeError
+        assert (await s.hgetall("block:AA"))["now"] == "a hash"
+        await s.close()
+
+    run(main())

@@ -12,6 +12,12 @@ JSON files:
 and advances the ``snapshot_*`` fields so the next run starts from zero. The
 per-payout uuid doubles as the idempotent node ``send`` id downstream
 (reference payouts.py:95).
+
+Migration note: the uuid derivation is keyed on the snapshot BASE values
+(stable across a crashed run and its rerun). If you hold an UNPAID payouts
+file produced by a build older than this note, pay it before upgrading or
+discard it and rerun — old- and new-format uuids differ, so mixing files
+across the upgrade loses the double-pay protection for that one window.
 """
 
 from __future__ import annotations
@@ -51,13 +57,16 @@ async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
         )
         if new_works < min_works:
             continue
-        # Deterministic uuid keyed on the exact counter state being
-        # snapshotted: a rerun over unchanged counters re-derives the SAME
-        # uuid, and that uuid is the node's idempotent send id downstream
-        # (reference payouts.py:95) — so even if an operator pays from both
-        # a crashed run's file and its rerun, nobody is paid twice.
+        # Deterministic uuid keyed on the snapshot BASE (the snapshot_*
+        # values) — NOT the live counters: the base only advances after a
+        # successful run, so a crashed run's file and its rerun share the
+        # same uuid even if more works landed in between, and that uuid is
+        # the node's idempotent send id downstream (reference payouts.py:95).
+        # Paying both files then sends at most once — never a double pay;
+        # worst case (paying the stale smaller file first) underpays the
+        # in-between delta, the conservative failure for a money path.
         state = ":".join(
-            f"{record.get(f, 0)}/{record.get(f'snapshot_{f}', 0)}" for f in WORK_FIELDS
+            f"{record.get(f'snapshot_{f}', 0)}" for f in WORK_FIELDS
         )
         payouts[addr] = {
             "works": new_works,

@@ -40,11 +40,10 @@ def plan_payouts(payouts: dict, balance_raw: int, fraction: float) -> dict:
     if total_works == 0:
         return {}
     pool = int(Decimal(balance_raw) * Decimal(str(fraction)))
-    return {
-        addr: pool * p["works"] // total_works
-        for addr, p in payouts.items()
-        if pool * p["works"] // total_works > 0
+    shares = {
+        addr: pool * p["works"] // total_works for addr, p in payouts.items()
     }
+    return {addr: share for addr, share in shares.items() if share > 0}
 
 
 def main(argv=None) -> int:

@@ -50,9 +50,34 @@ class NanoWebsocketClient:
                     await self._subscribe(ws)
                     delay = 1.0
                     async for raw in ws:
-                        data = json.loads(raw)
-                        if data.get("topic") == "confirmation":
-                            await self.callback(data["message"])
+                        # Message-level problems must not tear down a healthy
+                        # socket (that loses every confirmation in the
+                        # reconnect backoff window) — and a failing HANDLER
+                        # must not masquerade as a bad node frame, or the
+                        # operator debugs the feed instead of the handler.
+                        try:
+                            data = json.loads(raw)
+                            message = (
+                                data["message"]
+                                if data.get("topic") == "confirmation"
+                                else None
+                            )
+                        except Exception:
+                            logger.warning(
+                                "bad node frame skipped: %.120r", raw, exc_info=True
+                            )
+                            continue
+                        if message is None:
+                            continue
+                        try:
+                            await self.callback(message)
+                        except Exception:
+                            logger.error(
+                                "confirmation handler failed for %s",
+                                message.get("hash") if isinstance(message, dict)
+                                else message,
+                                exc_info=True,
+                            )
             except asyncio.CancelledError:
                 return
             except Exception as e:

@@ -31,6 +31,7 @@ import abc
 import asyncio
 import fnmatch
 import json
+import os
 import time
 from typing import Callable, Dict, Iterable, Optional
 
@@ -255,8 +256,11 @@ class MemoryStore(Store):
         return json.dumps({"version": 1, "entries": entries})
 
     def restore(self, blob: str) -> None:
+        """Make the store exactly the snapshot's state (replace, not merge)."""
         data = json.loads(blob)
         now = self._clock()
+        self._data.clear()
+        self._expiry.clear()
         for entry in data["entries"]:
             key, kind, value = entry["key"], entry["kind"], entry["value"]
             if kind == "set":
@@ -269,8 +273,14 @@ class MemoryStore(Store):
                 self._expiry[key] = now + entry["ttl"]
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
+        # Atomic replace: a crash/ENOSPC mid-write must never truncate the
+        # only durable copy (the periodic checkpoint overwrites in place).
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
             f.write(self.snapshot())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def load(self, path: str) -> None:
         with open(path) as f:

@@ -40,11 +40,25 @@ class RedisStore(Store):  # pragma: no cover - needs a live redis server
     async def get(self, key: str) -> Optional[str]:
         return await self._redis.get(key)
 
+    @staticmethod
+    def _px(expire: Optional[float]) -> Optional[int]:
+        """Float-seconds TTL → redis px milliseconds.
+
+        The Store contract takes float seconds (sub-second TTLs included —
+        the suite pins expire=0.05); ex=int(expire) truncated those to 0,
+        which Redis rejects outright. Clamp to >=1 ms.
+        """
+        if expire is None:
+            return None
+        # expire=0 must behave as already-expired (MemoryStore/Sqlite
+        # parity: deadline = now+0), not as "no TTL": clamp to 1 ms.
+        return max(1, int(expire * 1000))
+
     async def set(self, key: str, value: str, expire: Optional[float] = None) -> None:
-        await self._redis.set(key, value, ex=int(expire) if expire else None)
+        await self._redis.set(key, value, px=self._px(expire))
 
     async def setnx(self, key: str, value: str, expire: Optional[float] = None) -> bool:
-        ok = await self._redis.set(key, value, nx=True, ex=int(expire) if expire else None)
+        ok = await self._redis.set(key, value, nx=True, px=self._px(expire))
         return bool(ok)
 
     async def delete(self, *keys: str) -> int:

@@ -111,14 +111,23 @@ class SqliteStore(Store):
     def _expect_type(self, key: str, table: str) -> None:
         """MemoryStore/Redis parity: one key, one type — a string op on a
         hash key (or any cross-type mix) must raise, not fork the key into
-        parallel lives in two tables."""
+        parallel lives in two tables. Expired-but-unswept kv rows do not
+        count (MemoryStore parity: an expired key is simply gone)."""
         others = {"kv": "string", "hashes": "hash", "sets_": "set"}
+        now = time.time()
         for t, name in others.items():
             if t == table:
                 continue
-            row = self._db.execute(
-                f"SELECT 1 FROM {t} WHERE key = ? LIMIT 1", (key,)
-            ).fetchone()
+            if t == "kv":
+                row = self._db.execute(
+                    "SELECT 1 FROM kv WHERE key = ? AND "
+                    "(expires_at IS NULL OR expires_at > ?) LIMIT 1",
+                    (key, now),
+                ).fetchone()
+            else:
+                row = self._db.execute(
+                    f"SELECT 1 FROM {t} WHERE key = ? LIMIT 1", (key,)
+                ).fetchone()
             if row is not None:
                 raise TypeError(f"{key!r} holds a {name}, wrong operation type")
 
@@ -159,7 +168,16 @@ class SqliteStore(Store):
         return n
 
     async def exists(self, key: str) -> bool:
-        return self._get_row(key) is not None
+        # Any-type existence (Redis EXISTS / MemoryStore _alive parity):
+        # a key holding a hash or set exists just as much as a string key.
+        if self._get_row(key) is not None:
+            return True
+        for t in ("hashes", "sets_"):
+            if self._db.execute(
+                f"SELECT 1 FROM {t} WHERE key = ? LIMIT 1", (key,)
+            ).fetchone():
+                return True
+        return False
 
     async def incrby(self, key: str, amount: int = 1) -> int:
         self._expect_type(key, "kv")

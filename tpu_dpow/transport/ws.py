@@ -207,6 +207,7 @@ class WsTransport(TcpTransport):
         self.url = url
         self._http: Optional[ClientSession] = None
         self._ws = None
+        self._closing: list = []  # detached ws.close() tasks to await in close()
 
     @classmethod
     def from_uri(cls, uri: str, **kwargs) -> "WsTransport":
@@ -247,10 +248,16 @@ class WsTransport(TcpTransport):
         self._connected = False
         ws, self._ws = self._ws, None
         if ws is not None and not ws.closed:
-            asyncio.ensure_future(ws.close())
+            # Mid-run reconnects can only detach the close (sync context);
+            # close() awaits every detached task so teardown never races
+            # the session's own shutdown or leaks "never retrieved" noise.
+            self._closing.append(asyncio.ensure_future(ws.close()))
 
     async def close(self) -> None:
         await super().close()
+        if self._closing:
+            await asyncio.gather(*self._closing, return_exceptions=True)
+            self._closing.clear()
         if self._http is not None:
             await self._http.close()
             self._http = None

@@ -38,6 +38,9 @@ async def amain(argv=None) -> None:
     host, _, port_str = ns.listen.rpartition(":")
     if not port_str.isdigit():
         p.error(f"--listen must be host:port, got {ns.listen!r}")
+    # IPv6 literals arrive bracketed ('[::1]:7000' — the node RPC default
+    # elsewhere is 'http://[::1]:7076'); getaddrinfo wants them bare.
+    host = host.strip("[]")
     kwargs = {"threads": ns.threads} if ns.backend == "native" and ns.threads else {}
     if ns.backend == "jax" and ns.mesh_devices > 1:
         kwargs["mesh_devices"] = ns.mesh_devices
