@@ -27,7 +27,11 @@ async def run(url: str, n: int, user: str, api_key: str) -> int:
                 }
                 start = time.perf_counter()
                 await ws.send_json(request)
-                reply = json.loads((await ws.receive()).data)
+                msg = await ws.receive()
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    print(f"[{i}] connection lost ({msg.type})")
+                    return 1
+                reply = json.loads(msg.data)
                 elapsed = (time.perf_counter() - start) * 1000
                 ok = "work" in reply
                 print(f"[{i}] {'ok' if ok else reply}  {elapsed:.1f} ms")

@@ -91,3 +91,28 @@ def test_denominations():
 def test_expected_hashes():
     assert nc.expected_hashes(nc.BASE_DIFFICULTY) == pytest.approx(2**26, rel=1e-6)
     assert nc.expected_hashes(0xFFFFFFF800000000) == pytest.approx(2**29, rel=1e-6)
+
+
+def test_validation_rejects_trailing_newline():
+    """'$' would match before a trailing newline; the canonical forms must
+    reject it outright (regression: 'HASH\\n' validated and forked store
+    keys + winner locks from the 'HASH' spelling)."""
+    h = "A" * 64
+    with pytest.raises(nc.InvalidBlockHash):
+        nc.validate_block_hash(h + "\n")
+    with pytest.raises(nc.InvalidWork):
+        nc.validate_work_hex("0123456789abcdef\n")
+    with pytest.raises(nc.InvalidDifficulty):
+        nc.validate_difficulty("ffffffc000000000\n")
+
+
+def test_validate_account_canonicalizes_xrb_prefix():
+    nano = nc.encode_account(bytes(range(32)))
+    xrb = "xrb_" + nano[len("nano_"):]
+    assert nc.validate_account(xrb) == nano
+    assert nc.validate_account(nano) == nano
+
+
+def test_raw_to_nano_exact_at_supply_scale():
+    raw = 133248297920938463463374607431768211455  # 39 digits
+    assert nc.nano_to_raw(str(nc.raw_to_nano(raw))) == raw

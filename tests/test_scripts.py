@@ -280,3 +280,52 @@ def test_services_cli_on_sqlite_store(tmp_path):
         await s.close()
 
     asyncio.run(inspect())
+
+
+def test_snapshot_uuid_stable_across_crash_rerun_unique_across_windows(tmp_path):
+    """The send id must (a) survive a crashed run's rerun unchanged — even
+    when more works land in between — so paying both files can't double-pay,
+    and (b) DIFFER across genuinely distinct payout windows even if the
+    counters return to identical values (counter reset / fresh store),
+    where base-only keying would deterministically collide and the node
+    would swallow the later window's send."""
+    store = MemoryStore()
+    _seed_clients(store)
+
+    async def flow():
+        # run 1 crashes AFTER writing the payout file, BEFORE advancing the
+        # counters (the real crash window: the advance hset explodes).
+        real_hset = store.hset
+
+        async def crashing_hset(key, mapping):
+            if any(k.startswith("snapshot_") for k in mapping):
+                raise RuntimeError("crash before advance")
+            return await real_hset(key, mapping)
+
+        store.hset = crashing_hset
+        with pytest.raises(RuntimeError):
+            await cs.snapshot(store, out_dir=str(tmp_path / "a"))
+        store.hset = real_hset
+        (payouts_a,) = (tmp_path / "a").glob("payouts_*.json")
+        u1 = json.load(open(payouts_a))[VALID_ACCOUNT]["uuid"]
+        # +50 more works land between the crash and the rerun
+        await store.hset(
+            f"client:{VALID_ACCOUNT}",
+            {"precache": "150", "ondemand": "30"},
+        )
+        r2 = await cs.snapshot(store, out_dir=str(tmp_path / "b"))
+        u2 = json.load(open(r2["payouts_file"]))[VALID_ACCOUNT]["uuid"]
+        assert u1 == u2  # crash-rerun shares the send id
+
+        # window 3 after a counter reset back to the SAME base values
+        await store.hset(
+            f"client:{VALID_ACCOUNT}",
+            {"precache": "100", "ondemand": "30", "snapshot_precache": "50",
+             "snapshot_ondemand": "0"},
+        )
+        r3 = await cs.snapshot(store, out_dir=str(tmp_path / "c"))
+        u3 = json.load(open(r3["payouts_file"]))[VALID_ACCOUNT]["uuid"]
+        assert u3 != u1  # fresh window, fresh send id
+
+    (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir(); (tmp_path / "c").mkdir()
+    run(flow())

@@ -483,3 +483,33 @@ def test_stale_raised_difficulty_cleared_on_base_redispatch():
             assert msg.payload.split(",")[1] == f"{EASY_BASE:016x}"
 
     run(main())
+
+
+def test_throttler_fractional_rate_and_count_semantics():
+    """Throttler(0.5) = one admit per 2 s; Throttler(10, 60) = 10 per
+    minute, NOT 600 (asyncio_throttle parameter semantics)."""
+    from tpu_dpow.utils.throttle import Throttler
+
+    async def main():
+        clock = lambda: clock.now
+        clock.now = 0.0
+        t = Throttler(0.5, clock=clock)
+        async with t:
+            pass
+        entered = []
+
+        async def second():
+            async with t:
+                entered.append(clock.now)
+
+        task = asyncio.ensure_future(second())
+        await asyncio.sleep(0.05)
+        assert not entered  # still inside the 2 s window
+        clock.now = 2.1
+        await asyncio.wait_for(task, 5)
+        assert entered  # admitted once the scaled window slid
+
+        t10 = Throttler(10, 60, clock=clock)
+        assert t10._capacity == 10 and t10._window == 60
+
+    run(main())

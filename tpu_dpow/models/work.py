@@ -9,7 +9,7 @@ the server, client, backends and the device code.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from ..utils import nanocrypto as nc

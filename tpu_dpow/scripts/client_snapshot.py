@@ -14,8 +14,9 @@ per-payout uuid doubles as the idempotent node ``send`` id downstream
 (reference payouts.py:95).
 
 Migration note: the uuid derivation is keyed on the snapshot BASE values
-(stable across a crashed run and its rerun). If you hold an UNPAID payouts
-file produced by a build older than this note, pay it before upgrading or
+plus a store-persisted per-window seed (stable across a crashed run and its
+rerun, unique across counter resets). If you hold an UNPAID payouts file
+produced by a build older than this note, pay it before upgrading or
 discard it and rerun — old- and new-format uuids differ, so mixing files
 across the upgrade loses the double-pay protection for that one window.
 """
@@ -57,20 +58,31 @@ async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
         )
         if new_works < min_works:
             continue
-        # Deterministic uuid keyed on the snapshot BASE (the snapshot_*
-        # values) — NOT the live counters: the base only advances after a
-        # successful run, so a crashed run's file and its rerun share the
-        # same uuid even if more works landed in between, and that uuid is
-        # the node's idempotent send id downstream (reference payouts.py:95).
-        # Paying both files then sends at most once — never a double pay;
-        # worst case (paying the stale smaller file first) underpays the
-        # in-between delta, the conservative failure for a money path.
+        # Deterministic uuid keyed on (snapshot BASE, per-window seed):
+        #   * the base only advances after a successful run, and the seed —
+        #     persisted in the store BEFORE the payout file exists — only
+        #     rotates with that advance, so a crashed run's file and its
+        #     rerun share the uuid even if more works landed in between:
+        #     paying both sends at most once (the uuid is the node's
+        #     idempotent send id downstream, reference payouts.py:95);
+        #   * the random seed makes uuids unique across payout windows even
+        #     when counters reset to identical values (fresh store, wipe) —
+        #     base-only keying would deterministically collide there and
+        #     the node would silently swallow the later window's send.
+        seed_key = f"payout-seed:{addr}"
+        seed = await store.get(seed_key)
+        if seed is None:
+            seed = str(uuid.uuid4())
+            if not dry_run:
+                await store.set(seed_key, seed)
         state = ":".join(
             f"{record.get(f'snapshot_{f}', 0)}" for f in WORK_FIELDS
         )
         payouts[addr] = {
             "works": new_works,
-            "uuid": str(uuid.uuid5(uuid.NAMESPACE_URL, f"tpu-dpow:{addr}:{state}")),
+            "uuid": str(
+                uuid.uuid5(uuid.NAMESPACE_URL, f"tpu-dpow:{addr}:{state}:{seed}")
+            ),
         }
 
     # Durability order matters (this is money-adjacent): persist the payout
@@ -93,6 +105,10 @@ async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
                 f"client:{addr}",
                 {f"snapshot_{f}": snap[addr].get(f, "0") for f in WORK_FIELDS},
             )
+            # Rotate the uuid seed WITH the base advance: the next payout
+            # window derives fresh send ids (a crash mid-loop leaves this
+            # addr's seed in place, so its rerun still reuses the uuid).
+            await store.delete(f"payout-seed:{addr}")
     return {
         "clients_eligible": len(payouts),
         "total_works": sum(p["works"] for p in payouts.values()),

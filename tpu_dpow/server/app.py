@@ -178,12 +178,26 @@ class DpowServer:
     # result path (reference dpow_server.py:95-168)
     # ------------------------------------------------------------------
 
-    async def client_update(self, account: str, work_type: str, block_rewarded: str) -> None:
+    async def client_update(
+        self,
+        account: str,
+        work_type: str,
+        block_rewarded: str,
+        reply_to: Optional[str] = None,
+    ) -> None:
+        """Credit ``account`` (canonical spelling) and push its stats.
+
+        ``reply_to``: the spelling the worker REPORTED — an xrb_-configured
+        worker subscribes client/xrb_..., so the push must go to that topic
+        even though accounting keys on the canonical nano_ form.
+        """
         await self.store.hincrby(f"client:{account}", work_type, 1)
         stats = await self.store.hgetall(f"client:{account}")
         payload = {k: int(v) for k, v in stats.items()}
         payload["block_rewarded"] = block_rewarded
-        await self.transport.publish(f"client/{account}", json.dumps(payload), qos=QOS_1)
+        await self.transport.publish(
+            f"client/{reply_to or account}", json.dumps(payload), qos=QOS_1
+        )
 
     async def client_result_handler(self, topic: str, content: str) -> None:
         try:
@@ -222,7 +236,12 @@ class DpowServer:
         await self.transport.publish(f"cancel/{work_type}", block_hash, qos=QOS_1)
 
         try:
-            nc.validate_account(client)
+            # Canonical spelling for ACCOUNTING (crediting the raw string
+            # would split an xrb_-reporting worker's stats from its nano_
+            # alias); the stats push still goes to the reported spelling,
+            # which is the topic that worker actually subscribes.
+            reported = client
+            client = nc.validate_account(client)
         except nc.InvalidAccount:
             await self.transport.publish(
                 f"client/{client}",
@@ -232,7 +251,7 @@ class DpowServer:
             return
 
         await asyncio.gather(
-            self.client_update(client, work_type, block_hash),
+            self.client_update(client, work_type, block_hash, reply_to=reported),
             self.store.incrby(f"stats:{work_type}"),
             self.store.sadd("clients", client),
         )
@@ -370,9 +389,9 @@ class DpowServer:
                 raise InvalidRequest("Invalid hash")
             account = data.get("account")
             if account:
-                account = str(account).replace("xrb_", "nano_")
                 try:
-                    nc.validate_account(account)
+                    # validate_account owns canonicalization (xrb_ → nano_)
+                    account = nc.validate_account(str(account))
                 except nc.InvalidAccount:
                     raise InvalidRequest("Invalid account")
             difficulty = self._resolve_difficulty(data)

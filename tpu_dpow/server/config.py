@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import argparse
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from ..utils import nanocrypto as nc
@@ -81,4 +81,4 @@ def parse_args(argv=None) -> ServerConfig:
                    default=c.base_difficulty)
     p.add_argument("--log_file", default=None)
     ns = p.parse_args(argv)
-    return ServerConfig(**{k: v for k, v in vars(ns).items()})
+    return ServerConfig(**vars(ns))

@@ -251,6 +251,17 @@ class WsTransport(TcpTransport):
             # Mid-run reconnects can only detach the close (sync context);
             # close() awaits every detached task so teardown never races
             # the session's own shutdown or leaks "never retrieved" noise.
+            # Prune finished entries here, or a flaky link reconnecting for
+            # days accumulates dead Task objects without bound — retrieving
+            # each pruned task's exception so asyncio doesn't log it at GC.
+            kept = []
+            for t in self._closing:
+                if t.done():
+                    if not t.cancelled():
+                        t.exception()
+                else:
+                    kept.append(t)
+            self._closing = kept
             self._closing.append(asyncio.ensure_future(ws.close()))
 
     async def close(self) -> None:

@@ -3,6 +3,11 @@
 Capability parity with the reference's hybrid watched/timed rotating
 handlers (reference server/dpow/logger.py, client/logger.py): daily
 rotation, bounded backups, DEBUG to file / INFO to stdout.
+
+Handlers are attached ONCE to the package root logger ("tpu_dpow") and
+children propagate into them — configuring "tpu_dpow.client" with a
+--log_file must also capture tpu_dpow.backend / tpu_dpow.transport
+warnings, not just the one child the entrypoint happened to name.
 """
 
 from __future__ import annotations
@@ -13,48 +18,45 @@ import os
 import sys
 from typing import Optional
 
+_ROOT = "tpu_dpow"
+
 
 def get_logger(
-    name: str = "tpu_dpow",
+    name: str = _ROOT,
     *,
     file_path: Optional[str] = None,
     debug: bool = False,
     backup_count: int = 30,
 ) -> logging.Logger:
     """Module-level logger accessor; configures defaults on first touch."""
-    logger = logging.getLogger(name)
-    if logger.handlers:
-        if file_path or debug:
-            # An entrypoint passing explicit flags AFTER import-time default
-            # setup (api.py etc. call get_logger at module level) must win.
-            return configure_logger(
-                name, file_path=file_path, debug=debug, backup_count=backup_count
-            )
-        return logger
-    return configure_logger(
-        name, file_path=file_path, debug=debug, backup_count=backup_count
-    )
+    root = logging.getLogger(_ROOT)
+    if not root.handlers or file_path or debug:
+        # First touch, or an entrypoint passing explicit flags AFTER
+        # import-time default setup (api.py etc. call get_logger at module
+        # level) — explicit flags must win.
+        configure_logger(file_path=file_path, debug=debug, backup_count=backup_count)
+    return logging.getLogger(name)
 
 
 def configure_logger(
-    name: str = "tpu_dpow",
+    name: str = _ROOT,
     *,
     file_path: Optional[str] = None,
     debug: bool = False,
     backup_count: int = 30,
 ) -> logging.Logger:
-    """(Re)build the logger's handlers from the given flags."""
-    logger = logging.getLogger(name)
-    for handler in list(logger.handlers):
-        logger.removeHandler(handler)
+    """(Re)build the package root's handlers from the given flags."""
+    root = logging.getLogger(_ROOT)
+    for handler in list(root.handlers):
+        root.removeHandler(handler)
         handler.close()
-    logger.setLevel(logging.DEBUG)
+    root.setLevel(logging.DEBUG)
     fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     stream = logging.StreamHandler(sys.stdout)
     stream.setLevel(logging.DEBUG if debug else logging.INFO)
     stream.setFormatter(fmt)
-    logger.addHandler(stream)
+    root.addHandler(stream)
 
     if file_path:
         os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
@@ -63,5 +65,5 @@ def configure_logger(
         )
         fileh.setLevel(logging.DEBUG)
         fileh.setFormatter(fmt)
-        logger.addHandler(fileh)
-    return logger
+        root.addHandler(fileh)
+    return logging.getLogger(name)

@@ -24,16 +24,19 @@ from __future__ import annotations
 import hashlib
 import re
 import struct
-from decimal import Decimal
+from decimal import Decimal, localcontext
 
 # Nano mainnet send/base difficulty at the time of the reference snapshot
 # (reference docs/specification.md:30).
 BASE_DIFFICULTY = 0xFFFFFFC000000000
 MAX_U64 = (1 << 64) - 1
 
-_HASH_RE = re.compile(r"^[0-9A-Fa-f]{64}$")
-_WORK_RE = re.compile(r"^[0-9A-Fa-f]{16}$")
-_DIFFICULTY_RE = re.compile(r"^[0-9A-Fa-f]{1,16}$")
+# \Z, not $: '$' also matches before a trailing newline, so 'HASH\n' would
+# validate and the newline would ride into store keys, winner locks, and
+# wire payloads — two distinct keys (and winner elections) for one block.
+_HASH_RE = re.compile(r"^[0-9A-Fa-f]{64}\Z")
+_WORK_RE = re.compile(r"^[0-9A-Fa-f]{16}\Z")
+_DIFFICULTY_RE = re.compile(r"^[0-9A-Fa-f]{1,16}\Z")
 
 # Nano's base32 alphabet (no 0, 2, l, v).
 _B32_ALPHABET = "13456789abcdefghijkmnopqrstuwxyz"
@@ -191,7 +194,17 @@ def decode_account(account: str) -> bytes:
 
 
 def validate_account(account: str) -> str:
+    """Validate → the CANONICAL nano_ spelling.
+
+    xrb_ is accepted on input but never returned: reward accounting keys
+    on the address string (client:{addr}, the clients set), so returning
+    the input verbatim would split one worker's credit across two alias
+    spellings — the same alias-splitting the codec's pad-bit rejection
+    exists to prevent. Callers must use the return value.
+    """
     decode_account(account)
+    if account.startswith("xrb_"):
+        return "nano_" + account[len("xrb_"):]
     return account
 
 
@@ -204,8 +217,15 @@ def is_valid_account(account: str) -> bool:
 
 
 def nano_to_raw(amount: str | float | Decimal) -> int:
-    return int(Decimal(str(amount)) * RAW_PER_NANO)
+    with localcontext() as ctx:
+        ctx.prec = 50
+        return int(Decimal(str(amount)) * RAW_PER_NANO)
 
 
 def raw_to_nano(raw: int) -> Decimal:
-    return Decimal(raw) / RAW_PER_NANO
+    # Default Decimal context is 28 significant digits; supply-scale raw
+    # amounts have 39 — the payout CLI would display silently rounded
+    # balances for the operator to confirm against exact raw sends.
+    with localcontext() as ctx:
+        ctx.prec = 50
+        return Decimal(raw) / RAW_PER_NANO
