@@ -5,7 +5,7 @@ import asyncio
 import numpy as np
 import pytest
 
-from tpu_dpow.backend import WorkCancelled, get_backend
+from tpu_dpow.backend import WorkCancelled, WorkError, get_backend
 from tpu_dpow.backend.jax_backend import JaxWorkBackend
 from tpu_dpow.models import WorkRequest, WorkType
 from tpu_dpow.utils import nanocrypto as nc
@@ -445,3 +445,13 @@ def test_mixed_difficulty_launches_split_by_rung():
         await b.close()
 
     asyncio.run(run())
+
+
+def test_jax_backend_rejects_oversize_window_at_construction():
+    """A geometry whose per-dispatch window crosses the kernel's 2^31-offset
+    cap must fail at __init__ with the actual constraint, not from deep
+    inside the first launch."""
+    from tpu_dpow.backend.jax_backend import JaxWorkBackend
+
+    with pytest.raises(WorkError, match="2\\^31"):
+        JaxWorkBackend(kernel="pallas", sublanes=32, iters=4096, nblocks=128)

@@ -9,10 +9,11 @@ in-process engine built on the chunk scanners in ops/:
     random starting nonce (SURVEY.md §2.5) — then advances deterministically
     chunk by chunk.
   * All active requests are packed into ONE fixed-shape batched launch per
-    engine step (padded with unreachable-difficulty dummies, so arrival and
-    completion never change the compiled shape — no recompiles, SURVEY.md
-    §7 hard part #4). Concurrent hashes share a single device dispatch,
-    replacing the reference's one-POST-per-item worker dialogue.
+    engine step (padded with difficulty-0 dummies that hit at offset 0 and
+    early-exit, so arrival and completion never change the compiled shape —
+    no recompiles, SURVEY.md §7 hard part #4). Concurrent hashes share a
+    single device dispatch, replacing the reference's one-POST-per-item
+    worker dialogue.
   * Cancels are lane masking: a cancelled job is dropped from the next
     pack; the chunk already in flight finishes and its result is discarded
     — the same cancel/completion race resolution the reference implements
@@ -65,7 +66,6 @@ class _Job:
     future: asyncio.Future
     base: int
     cancelled: bool = False
-    hashes_done: int = 0
     waiters: int = 0  # refcount: last cancelled waiter drops the job
 
     def set_base(self, base: int) -> None:
@@ -151,7 +151,14 @@ class JaxWorkBackend(WorkBackend):
         # ladder also may not cross the kernel's 2^31-offset limit.
         if run_steps is None:
             run_steps = 16 if on_tpu else 1
-        max_by_window = max(1, ((1 << 31) - 1) // self.chunk)
+        if self.chunk >= 1 << 31:
+            # Fail at construction with the actual constraint, not from deep
+            # inside the first launch's kernel-geometry check.
+            raise WorkError(
+                f"per-dispatch window {self.chunk} nonces (sublanes*128*iters"
+                f"*nblocks*mesh_devices) must stay below 2^31"
+            )
+        max_by_window = ((1 << 31) - 1) // self.chunk
         self.run_steps = max(1, min(run_steps, max_by_window))
         self.max_batch = max_batch
         self.interpret = interpret
@@ -557,13 +564,11 @@ class JaxWorkBackend(WorkBackend):
                 nonce = (int(hi) << 32) | int(lo)
                 if nonce == _MASK64:  # span exhausted without a hit
                     self.total_hashes += span
-                    job.hashes_done += span
                     if not job.future.done():
                         job.set_base(job.base + span)
                     continue
                 scanned = ((nonce - job.base) & _MASK64) + 1
                 self.total_hashes += scanned
-                job.hashes_done += scanned
                 if job.future.done():
                     continue  # cancelled while the launch was in flight: drop
                 work = search.work_hex_from_nonce(nonce)

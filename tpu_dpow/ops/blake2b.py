@@ -141,7 +141,11 @@ def compress_rolled(
 
     # Broadcast all 16 message words to a common shape; the switch branches
     # then just reorder these values per round, no data-dependent indexing.
-    shape = jnp.broadcast_shapes(*(jnp.shape(w[0]) for w in m))
+    # The batch shape may ride in on h as well as m (compress() broadcasts
+    # either way — this must accept the same signature).
+    shape = jnp.broadcast_shapes(
+        *(jnp.shape(w[0]) for w in m), *(jnp.shape(w[0]) for w in h)
+    )
     m_lo = [jnp.broadcast_to(jnp.asarray(w[0], jnp.uint32), shape) for w in m]
     m_hi = [jnp.broadcast_to(jnp.asarray(w[1], jnp.uint32), shape) for w in m]
 

@@ -96,9 +96,12 @@ def search_chunk_batch(
 
     Batching concurrent (hash, difficulty) requests into one launch is the
     rebuild's replacement for the reference's one-work-item-at-a-time POST
-    to the native worker (reference client/work_handler.py:98-108);
-    cancelled requests are masked by giving them an impossible difficulty
-    (all-ones) rather than re-tracing a smaller batch.
+    to the native worker (reference client/work_handler.py:98-108). The
+    engine keeps the launch shape fixed by DROPPING cancelled jobs from the
+    next pack and filling unused rows with difficulty-0 padding — a pad
+    "hits" at offset 0 and early-exits after one tile group (an
+    unreachable-difficulty pad would instead scan its whole window every
+    launch); see backend/jax_backend.py _pack.
     """
     if unroll is None:
         unroll = _default_unroll()
