@@ -11,6 +11,13 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
+# Make JAX_PLATFORMS effective even where a site hook pre-registers an
+# accelerator backend (it wins over the env var): the virtual-device recipe
+# in multichip.py's docstring depends on it, exactly like tests/conftest.py.
+from tpu_dpow.utils import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
 
 async def wait_for_warmup(backend, timeout: float = 600.0) -> None:
     """Block until the backend's launch-shape warm task finishes (if any).
