@@ -101,6 +101,14 @@ struct SearchShared {
   const volatile int32_t* cancel;       // host-owned flag, may be null
 };
 
+// The cancel word is written from a Python thread (ctypes c_int32); read it
+// with a real atomic load — plain volatile access is a formal data race.
+// The GCC/Clang builtin keeps the C ABI (no std::atomic in the signature).
+inline bool cancel_requested(const volatile int32_t* c) {
+  return c && __atomic_load_n(const_cast<const int32_t*>(c),
+                              __ATOMIC_RELAXED) != 0;
+}
+
 // Hashes between checks of the found/cancel atomics: small enough for
 // sub-millisecond cancel latency per thread, large enough to amortize.
 constexpr uint64_t CHECK_STRIDE = 1 << 16;
@@ -109,14 +117,21 @@ void search_thread(const uint64_t hash_words[4], uint64_t difficulty,
                    uint64_t base, uint64_t count, unsigned tid,
                    unsigned nthreads, SearchShared* sh) {
   uint64_t done = 0;
-  // Thread t scans blocks t, t+n, t+2n, ... of CHECK_STRIDE nonces.
-  for (uint64_t blk = tid; blk * CHECK_STRIDE < count; blk += nthreads) {
+  // Thread t scans blocks t, t+n, t+2n, ... of CHECK_STRIDE nonces. Block
+  // count is computed without the blk*CHECK_STRIDE product the old loop
+  // condition used, which wrapped for count close to 2^64 (ABI contract:
+  // any [base, base+count) mod 2^64 is legal, even if the Python backend
+  // only ever passes small chunks).
+  const uint64_t nblocks = count / CHECK_STRIDE + (count % CHECK_STRIDE != 0);
+  for (uint64_t blk = tid; blk < nblocks; blk += nthreads) {
     if (sh->found.load(std::memory_order_relaxed) ||
-        (sh->cancel && *sh->cancel)) {
+        cancel_requested(sh->cancel)) {
       break;
     }
     uint64_t lo = blk * CHECK_STRIDE;
-    uint64_t hi = lo + CHECK_STRIDE < count ? lo + CHECK_STRIDE : count;
+    // count - lo never underflows (lo < count); the old lo+CHECK_STRIDE
+    // comparison wrapped on the final block of a near-2^64 range.
+    uint64_t hi = (count - lo > CHECK_STRIDE) ? lo + CHECK_STRIDE : count;
     for (uint64_t off = lo; off < hi; off++) {
       uint64_t nonce = base + off;  // wraps mod 2^64, as specified
       if (pow_value(nonce, hash_words) >= difficulty) {
@@ -163,11 +178,27 @@ int bw_search_range(const uint8_t block_hash[32], uint64_t difficulty,
   if (n_threads == 1 || count <= CHECK_STRIDE) {
     search_thread(hw, difficulty, base, count, 0, 1, &sh);
   } else {
+    // tids 1..n-1 get OS threads; tid 0 runs on the calling thread (one
+    // fewer spawn per chunk). A std::thread that fails to spawn (EAGAIN /
+    // RLIMIT_NPROC) must NOT unwind across the C ABI into libffi —
+    // std::terminate would kill the whole Python process — so spawn
+    // failures degrade to running the missing tids inline instead.
     std::vector<std::thread> threads;
-    threads.reserve(n_threads);
-    for (int t = 0; t < n_threads; t++) {
-      threads.emplace_back(search_thread, hw, difficulty, base, count,
-                           (unsigned)t, (unsigned)n_threads, &sh);
+    int spawned = 0;
+    try {
+      threads.reserve(n_threads - 1);  // inside try: reserve can throw too
+      for (int t = 1; t < n_threads; t++) {
+        threads.emplace_back(search_thread, hw, difficulty, base, count,
+                             (unsigned)t, (unsigned)n_threads, &sh);
+        spawned++;
+      }
+    } catch (...) {
+      // fall through: tids spawned+1..n-1 run inline below
+    }
+    search_thread(hw, difficulty, base, count, 0, (unsigned)n_threads, &sh);
+    for (int t = spawned + 1; t < n_threads; t++) {
+      search_thread(hw, difficulty, base, count, (unsigned)t,
+                    (unsigned)n_threads, &sh);
     }
     for (auto& th : threads) th.join();
   }
@@ -176,7 +207,7 @@ int bw_search_range(const uint8_t block_hash[32], uint64_t difficulty,
     if (nonce_out) *nonce_out = sh.winner.load();
     return 1;
   }
-  return (cancel && *cancel) ? -1 : 0;
+  return cancel_requested(cancel) ? -1 : 0;
 }
 
 }  // extern "C"

@@ -84,21 +84,32 @@ def build_library(force: bool = False) -> str:
     stamp_path = out + ".stamp"
     if not os.path.exists(src):
         raise WorkError(f"native source not found: {src}")
+    march = os.environ.get("TPU_DPOW_NATIVE_MARCH", "native")
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3",
-        f"-march={os.environ.get('TPU_DPOW_NATIVE_MARCH', 'native')}",
+        f"-march={march}",
         "-funroll-loops",
         "-fPIC",
         "-std=c++17",
         "-shared",
         "-pthread",
     ]
-    stamp = f"{' '.join(cmd)}|{_host_cpu_identity()}"
+    # CPU identity matters only for -march=native output (different CPU =>
+    # possible SIGILL); a portable march on a shared volume must NOT embed
+    # one host's identity, or a heterogeneous fleet ping-pong-rebuilds the
+    # identical .so forever.
+    identity = _host_cpu_identity() if march == "native" else "portable"
+    stamp = f"{' '.join(cmd)}|{identity}"
     try:
         with open(stamp_path) as f:
             stamp_matches = f.read() == stamp
     except OSError:
+        # No/unreadable stamp => rebuild. A stamp-less .so could be a
+        # foreign-CPU -march=native artifact, and a SIGILL from dlopening
+        # it kills the process before any self-test can run — prebuild via
+        # `make -C native` (which routes through this builder and stamps)
+        # rather than invoking the compiler directly.
         stamp_matches = False
     stale = (
         force
@@ -172,6 +183,7 @@ class _NativeJob:
     future: asyncio.Future
     cancel_flag: ctypes.c_int32
     waiters: int = 0  # refcount: last cancelled waiter aborts the scan
+    task: Optional[asyncio.Task] = None  # strong ref: the loop holds tasks weakly
 
 
 class NativeWorkBackend(WorkBackend):
@@ -260,8 +272,12 @@ class NativeWorkBackend(WorkBackend):
             )
             self._jobs[key] = job
             # The scan is its own task, owned by no waiter: any one waiter
-            # giving up must not tear down a job others still share.
-            asyncio.ensure_future(self._run_job(key, request.hash_bytes, job))
+            # giving up must not tear down a job others still share. The job
+            # keeps the strong reference (the event loop holds tasks weakly
+            # — a GC'd task would strand every waiter on a dead future).
+            job.task = asyncio.ensure_future(
+                self._run_job(key, request.hash_bytes, job)
+            )
         return await self._await_job(job)
 
     async def _await_job(self, job: _NativeJob) -> str:
@@ -294,6 +310,11 @@ class NativeWorkBackend(WorkBackend):
                     job.future.set_result(work)
                 elif value >= difficulty:
                     # Target raised mid-flight: keep scanning past this hit.
+                    # nonce+1 re-covers blocks other threads had already
+                    # finished in the aborted chunk — deliberate: per-thread
+                    # progress isn't reported, any nonce is as good as any
+                    # other, and total_hashes counts the re-scan because it
+                    # is real compute.
                     base = (nonce + 1) & nc.MAX_U64
                 else:
                     job.future.set_exception(
