@@ -329,3 +329,72 @@ def test_snapshot_uuid_stable_across_crash_rerun_unique_across_windows(tmp_path)
 
     (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir(); (tmp_path / "c").mkdir()
     run(flow())
+
+
+# ---------------------------------------------------------------- payouts e2e
+
+
+def test_payouts_main_against_fake_node(tmp_path, monkeypatch, capsys):
+    """Full payouts CLI flow against a fake node RPC: balance fetch,
+    confirmation gate, idempotent send ids (the uuid from the snapshot is
+    the node 'id' — reference payouts.py:95), and dry-run short-circuit."""
+    import http.server
+    import threading
+
+    from tpu_dpow.scripts import payouts as po
+
+    sends = []
+
+    class FakeNode(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            if body["action"] == "account_balance":
+                reply = {"balance": str(10**30), "pending": "0"}
+            elif body["action"] == "send":
+                sends.append(body)
+                reply = {"block": "B" * 64}
+            else:
+                reply = {"error": f"unknown action {body['action']}"}
+            data = json.dumps(reply).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), FakeNode)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        node_uri = f"http://127.0.0.1:{srv.server_port}/"
+        addr2 = nc.encode_account(bytes([7] * 32))
+        pf = tmp_path / "payouts_1.json"
+        pf.write_text(json.dumps({
+            VALID_ACCOUNT: {"works": 75, "uuid": "uuid-a"},
+            addr2: {"works": 25, "uuid": "uuid-b"},
+        }))
+        base_args = [str(pf), "--node", node_uri, "--wallet", "W" * 64,
+                     "--source", VALID_ACCOUNT]
+
+        # dry run: prints the plan, never sends
+        assert po.main(base_args + ["--dry_run"]) == 0
+        assert sends == []
+        out = capsys.readouterr().out
+        assert "distributing" in out and "75 works" in out
+
+        # wrong confirmation phrase aborts
+        monkeypatch.setattr("builtins.input", lambda *_: "no")
+        assert po.main(base_args) == 1
+        assert sends == []
+
+        # confirmed: sends carry the snapshot uuids as idempotency keys
+        monkeypatch.setattr("builtins.input", lambda *_: po.CONFIRM_PHRASE)
+        assert po.main(base_args) == 0
+        assert {s["id"] for s in sends} == {"uuid-a", "uuid-b"}
+        assert all(s["source"] == VALID_ACCOUNT and s["action"] == "send"
+                   for s in sends)
+        by_id = {s["id"]: int(s["amount"]) for s in sends}
+        assert by_id["uuid-a"] == 3 * by_id["uuid-b"]  # 75 vs 25 works
+    finally:
+        srv.shutdown()

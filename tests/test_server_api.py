@@ -123,3 +123,31 @@ def test_upcheck_and_block_callback():
             assert any(m.topic == "work/precache" for m in hx.worker_log)
 
     run(main())
+
+
+def test_unix_socket_service_face(tmp_path):
+    """The nginx-facing deployment path: service API over a unix domain
+    socket (web_path), group-writable perms (reference socket.py:7-30
+    parity), serving the same POST contract."""
+    import os
+    import stat
+
+    async def main():
+        sock = str(tmp_path / "svc.sock")
+        async with ApiHarness(web_path=sock) as hx:
+            await hx.start_worker()
+            mode = os.stat(sock).st_mode
+            assert stat.S_ISSOCK(mode)
+            assert mode & stat.S_IWGRP  # group-writable for the proxy user
+            h = random_hash()
+            conn = aiohttp.UnixConnector(path=sock)
+            async with aiohttp.ClientSession(connector=conn) as http:
+                async with http.post(
+                    "http://unix/service/",
+                    json={"user": "svc", "api_key": "secret", "hash": h},
+                ) as resp:
+                    body = await resp.json()
+            assert body["hash"] == h
+            nc.validate_work(h, body["work"], EASY_BASE)
+
+    run(main())

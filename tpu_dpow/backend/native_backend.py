@@ -28,8 +28,11 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 from ..models import WorkRequest
-from ..ops import search
 from ..utils import nanocrypto as nc
+
+# NOTE: tpu_dpow.ops (jax) is imported lazily in the scan path only — a
+# builder stage prebuilding the .so via `make -C native` needs
+# build_library() importable on a box with no jax at all.
 from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
 
 _NATIVE_DIR = os.path.join(
@@ -302,6 +305,8 @@ class NativeWorkBackend(WorkBackend):
                 if not found:
                     base = (base + self.chunk) & nc.MAX_U64
                     continue
+                from ..ops import search
+
                 work = search.work_hex_from_nonce(nonce)
                 value = nc.work_value(key, work)
                 if value >= job.difficulty:
