@@ -1,0 +1,42 @@
+"""Logger factory: root-handler propagation (a --log_file must capture the
+whole package, not only the entrypoint's own child logger)."""
+
+import logging
+
+from tpu_dpow.utils.logging import configure_logger, get_logger
+
+
+def _cleanup():
+    root = logging.getLogger("tpu_dpow")
+    for h in list(root.handlers):
+        root.removeHandler(h)
+        h.close()
+
+
+def test_log_file_captures_sibling_loggers(tmp_path):
+    try:
+        path = str(tmp_path / "client.log")
+        configure_logger("tpu_dpow.client", file_path=path)
+        # a SIBLING subsystem logs; the configured file must capture it
+        # (regression: handlers sat on the named child, so backend/transport
+        # warnings bypassed the file entirely)
+        get_logger("tpu_dpow.backend").warning("engine warning %d", 7)
+        get_logger("tpu_dpow.client").info("client info")
+        for h in logging.getLogger("tpu_dpow").handlers:
+            h.flush()
+        text = open(path).read()
+        assert "engine warning 7" in text
+        assert "client info" in text
+    finally:
+        _cleanup()
+
+
+def test_reconfigure_does_not_stack_handlers(tmp_path):
+    try:
+        configure_logger(file_path=str(tmp_path / "a.log"))
+        configure_logger(file_path=str(tmp_path / "b.log"))
+        root = logging.getLogger("tpu_dpow")
+        # one stream + one file handler, not an accumulation
+        assert len(root.handlers) == 2
+    finally:
+        _cleanup()

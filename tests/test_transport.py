@@ -528,3 +528,68 @@ def test_tcp_hugely_overlong_line_still_answered():
             await srv.stop()
 
     run(main())
+
+
+def test_pattern_covers_containment_property():
+    """Property check: pattern_covers(grant, sub) == (every topic matching
+    sub also matches grant), exercised over the full enumeration of 3-level
+    patterns/topics from a small alphabet — the ACL matrix's security rests
+    on this equivalence."""
+    import itertools
+
+    from tpu_dpow.transport import pattern_covers
+
+    seg_choices = ["a", "b", "+"]
+    topic_segs = ["a", "b", "c"]
+    patterns = ["#"]
+    for depth in (1, 2, 3):
+        for segs in itertools.product(seg_choices, repeat=depth):
+            patterns.append("/".join(segs))
+            if depth < 3:
+                patterns.append("/".join(segs) + "/#")
+    topics = [
+        "/".join(t)
+        for depth in (1, 2, 3)
+        for t in itertools.product(topic_segs, repeat=depth)
+    ]
+    checked = 0
+    for grant in patterns:
+        for sub in patterns:
+            claimed = pattern_covers(grant, sub)
+            actual = all(
+                topic_matches(grant, t) for t in topics if topic_matches(sub, t)
+            )
+            assert claimed == actual, (grant, sub, claimed, actual)
+            checked += 1
+    assert checked > 1000
+
+
+def test_subscribe_verdict_surfaces_over_the_wire():
+    """A denied subscription must raise AuthError at the CLIENT over both
+    wire dialects — previously subscribe() was fire-and-forget and a denied
+    worker just silently never received anything (regression, found by a
+    live drive). Confirmed subs join the reconnect replay set; denied ones
+    don't."""
+    from tpu_dpow.transport.mqtt import MqttTransport
+
+    async def main():
+        users = {
+            "narrow": User(password="n", acl_pub=(), acl_sub=("work/#",)),
+        }
+        srv = TcpBrokerServer(Broker(users=users), port=0)
+        await srv.start()
+        try:
+            for cls in (TcpTransport, MqttTransport):
+                t = cls(port=srv.port, username="narrow", password="n",
+                        client_id=f"nr-{cls.__name__}")
+                await t.connect()
+                with pytest.raises(AuthError):
+                    await t.subscribe("#", qos=0)
+                await t.subscribe("work/#", qos=0)
+                assert "work/#" in t._subscriptions
+                assert "#" not in t._subscriptions  # denied: not replayed
+                await t.close()
+        finally:
+            await srv.stop()
+
+    run(main())

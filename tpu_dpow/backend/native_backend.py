@@ -305,9 +305,11 @@ class NativeWorkBackend(WorkBackend):
                 if not found:
                     base = (base + self.chunk) & nc.MAX_U64
                     continue
-                from ..ops import search
-
-                work = search.work_hex_from_nonce(nonce)
+                # Nano's work field: u64 nonce as 16 big-endian hex chars
+                # (ops/search.work_hex_from_nonce, inlined — pulling in the
+                # jax-importing ops package here would crash a no-jax box at
+                # its FIRST solve and stall the solve path on a jax one).
+                work = f"{nonce:016x}"
                 value = nc.work_value(key, work)
                 if value >= job.difficulty:
                     # Host hashlib re-check: belt to the native suspenders.

@@ -79,7 +79,17 @@ def pattern_covers(grant: str, pattern: str) -> bool:
         if g_tok is None and s_tok is None:
             return True  # both exhausted: identical depth, all covered
         if s_tok == "#":
-            return False  # pattern wants a subtree the grant doesn't give
+            # The pattern admits suffixes of every length >= 0 here (MQTT
+            # '#' also matches the parent level) — except at i == 0, where
+            # the zero-length suffix would be the empty topic, which does
+            # not exist. A grant remainder of k '+' segments then '#'
+            # covers suffix lengths >= k, so containment holds iff
+            # k <= (1 if at top level else 0). k == 0 is the g_tok == '#'
+            # case above; k == 1 at top level is e.g. grant '+/#' vs '#'.
+            k = 0
+            while i + k < len(g) and g[i + k] == "+":
+                k += 1
+            return i + k < len(g) and g[i + k] == "#" and k <= (1 if i == 0 else 0)
         if g_tok is None or s_tok is None:
             return False  # depth mismatch without a '#' to absorb it
         if g_tok == "+":

@@ -69,7 +69,9 @@ class FrameConn:
                 )
                 send({"op": "suback", "pattern": frame["pattern"]})
             except AuthError as e:
-                send({"op": "error", "reason": str(e)})
+                # pattern included so the client can correlate the denial
+                # with its pending subscribe instead of just logging it.
+                send({"op": "error", "reason": str(e), "pattern": frame["pattern"]})
         elif op == "unsub":
             self.broker.unsubscribe(self.session, str(frame["pattern"]))
         elif op == "pub":

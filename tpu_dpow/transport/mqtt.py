@@ -173,6 +173,10 @@ class MqttTransport(TcpTransport):
     _sub_mid = None  # lazy counter for SUBSCRIBE/UNSUBSCRIBE packet ids
     _ping_task: Optional[asyncio.Task] = None
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._sub_mids: dict = {}  # pending SUBSCRIBE mid → pattern
+
     async def _connect_once(self) -> None:
         await super()._connect_once()
         if self._ping_task is None or self._ping_task.done():
@@ -220,9 +224,12 @@ class MqttTransport(TcpTransport):
                 mid=obj.get("mid"),
             )
         elif op == "sub":
-            pkt = mc.Subscribe(
-                mid=self._next_sub_mid(), topics=[(obj["pattern"], obj["qos"])]
-            )
+            mid = self._next_sub_mid()
+            # Remember which pattern this mid subscribed, so the SUBACK's
+            # per-topic code can resolve the pattern-keyed wait in
+            # TcpTransport.subscribe (JSON-face parity: denial raises).
+            self._sub_mids[mid] = obj["pattern"]
+            pkt = mc.Subscribe(mid=mid, topics=[(obj["pattern"], obj["qos"])])
         elif op == "unsub":
             pkt = mc.Unsubscribe(mid=self._next_sub_mid(), topics=[obj["pattern"]])
         elif op == "ping":
@@ -262,8 +269,16 @@ class MqttTransport(TcpTransport):
                 return {"op": "puback", "mid": pkt.mid}
             if isinstance(pkt, mc.Pingresp):
                 return {"op": "pong"}
-            if isinstance(pkt, (mc.Suback, mc.Unsuback)):
-                continue  # TcpTransport does not await these
+            if isinstance(pkt, mc.Suback):
+                pattern = self._sub_mids.pop(pkt.mid, None)
+                if pattern is None:
+                    continue  # replayed/unknown mid: nothing waiting
+                if pkt.codes and pkt.codes[0] == mc.SUBACK_FAILURE:
+                    return {"op": "error", "reason": f"subscription denied: {pattern!r}",
+                            "pattern": pattern}
+                return {"op": "suback", "pattern": pattern}
+            if isinstance(pkt, mc.Unsuback):
+                continue
             logger.debug("ignoring mqtt packet %r", pkt)
 
     # MQTT publish mids must fit 16 bits; TcpTransport's counter is fine for

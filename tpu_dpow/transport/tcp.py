@@ -191,6 +191,9 @@ class TcpTransport(Transport):
         self._writer: Optional[asyncio.StreamWriter] = None
         self._inbox: asyncio.Queue = asyncio.Queue(maxsize=10_000)
         self._acks: Dict[int, asyncio.Future] = {}
+        # pattern → WAITERS (list): concurrent subscribes to one pattern
+        # must not overwrite each other's pending verdict.
+        self._sub_acks: Dict[str, list] = {}
         self._mid = itertools.count(1)
         self._subscriptions: Dict[str, int] = {}
         self._rx_task: Optional[asyncio.Task] = None
@@ -237,6 +240,8 @@ class TcpTransport(Transport):
             self._closed = False
             self._inbox = asyncio.Queue(maxsize=10_000)
             self._acks = {}
+            # _sub_acks deliberately survives: a subscribe awaiting its
+            # verdict across a drop is resolved by the replayed suback.
         last_error: Optional[Exception] = None
         delay = 0.05
         for _ in range(max(self.reconnect_retries, 1)):
@@ -340,8 +345,22 @@ class TcpTransport(Transport):
                 fut = self._acks.pop(frame.get("mid"), None)
                 if fut is not None and not fut.done():
                     fut.set_result(True)
+            elif op == "suback":
+                for fut in self._sub_acks.pop(frame.get("pattern"), []):
+                    if not fut.done():
+                        fut.set_result(True)
             elif op == "error":
-                logger.warning("broker error: %s", frame.get("reason"))
+                # A denial carrying a pattern resolves those pending
+                # subscribes; anything else is just logged.
+                waiters = self._sub_acks.pop(frame.get("pattern"), [])
+                if waiters:
+                    for fut in waiters:
+                        if not fut.done():
+                            fut.set_exception(
+                                AuthError(frame.get("reason", "denied"))
+                            )
+                else:
+                    logger.warning("broker error: %s", frame.get("reason"))
         self._inbox.put_nowait(None)
 
     async def publish(self, topic: str, payload: str, qos: int = QOS_0) -> None:
@@ -361,8 +380,34 @@ class TcpTransport(Transport):
             await self._send(frame)
 
     async def subscribe(self, pattern: str, qos: int = QOS_0) -> None:
+        """Subscribe and WAIT for the broker's verdict: a denied pattern
+        raises AuthError here instead of silently never delivering (the
+        broker enforces either way; this is the client-side contract).
+
+        Registration is optimistic: a connection cut while the suback is in
+        flight lets the reconnect replay re-send the SUBSCRIBE, and the
+        replayed suback (pattern-keyed) resolves this same wait. An
+        explicit denial removes the pattern from the replay set.
+        """
         self._subscriptions[pattern] = qos
+        fut = asyncio.get_running_loop().create_future()
+        self._sub_acks.setdefault(pattern, []).append(fut)
         await self._send({"op": "sub", "pattern": pattern, "qos": qos})
+        try:
+            await asyncio.wait_for(fut, timeout=10.0)
+        except asyncio.TimeoutError:
+            waiters = self._sub_acks.get(pattern)
+            if waiters is not None:
+                try:
+                    waiters.remove(fut)
+                except ValueError:
+                    pass
+                if not waiters:
+                    self._sub_acks.pop(pattern, None)
+            raise TransportError(f"no suback for subscribe to {pattern!r}")
+        except AuthError:
+            self._subscriptions.pop(pattern, None)
+            raise
 
     async def messages(self) -> AsyncIterator[Message]:
         while True:
