@@ -11,7 +11,7 @@ JSON object per line:
     {"op": "pub", "topic": ..., "payload": ..., "qos": 0|1, "mid": int?}
     {"op": "ping"}
   broker → client:
-    {"op": "connack"} | {"op": "error", "reason": ...}
+    {"op": "connack"} | {"op": "error", "reason": ..., "pattern": str?}
     {"op": "suback", "pattern": ...}
     {"op": "puback", "mid": int}        (only for QoS-1 publishes with a mid)
     {"op": "msg", "topic": ..., "payload": ..., "qos": 0|1}
@@ -19,7 +19,9 @@ JSON object per line:
 
 QoS-1 publish = the client awaits the broker's puback (at-least-once into the
 broker; broker-side session queues take it the rest of the way — see
-transport/broker.py). Auto-reconnect with capped exponential backoff and
+transport/broker.py). Every subscribe is answered: suback on success, or an
+error frame carrying the denied pattern — subscribe() awaits the verdict and
+raises AuthError on denial (MQTT face parity: SUBACK failure code 0x80). Auto-reconnect with capped exponential backoff and
 subscription replay mirrors the reference's reconnect_retries/1000,
 max interval 10 s (reference server/dpow/mqtt.py:16-24) and the client's
 5000/120 s (reference client/dpow_client.py:52-56).
