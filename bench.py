@@ -10,11 +10,14 @@ SURVEY.md §6).
 Robustness contract (round-1 postmortem): backend *initialization* can fail
 (UNAVAILABLE if a stale process still holds the chip — libtpu is
 single-client) or block outright on tunnel setup. Neither may cost the round
-its perf artifact, so the measurement runs in a bounded child process:
-up to 2 TPU attempts with a timeout and a retry pause, then a CPU-pinned
-fallback child, and if everything fails the parent still prints a JSON line
-(value 0 + error) and exits 0. The child also guarantees nothing keeps
-holding the TPU after the bench: it exits as soon as the number is printed.
+its perf artifact, so the measurement runs in a bounded child process with an
+ASYMMETRIC retry policy: a fast failure (crash rc != 0) gets a pause and one
+retry, but a TIMEOUT means the tunnel is hanging — retrying would burn
+another full attempt for nothing, so it goes straight to the CPU-pinned
+fallback child. If everything fails the parent still prints a JSON line
+(value 0 + error) and exits 0. SIGTERM/SIGINT (the driver's own timeout
+killing this process) reaps the active child so no orphan keeps holding the
+TPU, and still prints a labeled JSON line on the way out.
 
 Extra diagnostics (geometry sweep, per-config latency runs) live in
 benchmarks/; this file stays minimal because the driver parses its stdout.
@@ -24,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -32,8 +36,10 @@ import numpy as np
 
 TARGET_HS = 1e9  # BASELINE.json north_star: >= 1e9 H/s/chip on v5e
 
-ATTEMPT_TIMEOUT = 300  # s per child: TPU first-compile alone can be 20-40 s
+ATTEMPT_TIMEOUT = 240  # s per child: TPU first-compile alone can be 20-40 s
 RETRY_PAUSE = 10  # s between TPU attempts (lets a stale chip holder die)
+
+_active_child = None  # reaped by the SIGTERM/SIGINT handler
 
 
 def measure(reps: int = 8) -> dict:
@@ -103,21 +109,41 @@ def _inproc(platform: str) -> int:
     return 0
 
 
-def _run_child(platform: str) -> dict | None:
-    """Run one bounded measurement child; return its parsed JSON or None."""
+def _run_child(platform: str) -> "dict | str | None":
+    """One bounded measurement child → parsed JSON, 'timeout', or None.
+
+    Uses Popen (not subprocess.run) so the module-level SIGTERM handler can
+    reap the child if the DRIVER's timeout kills this parent — an orphaned
+    child stuck in backend init would otherwise keep holding the TPU into
+    the next round step (the round-1 'stale chip holder' failure).
+    """
+    global _active_child
+    # Block termination signals across the spawn: a SIGTERM landing between
+    # Popen() and the _active_child store would orphan a child that the
+    # handler can't see — exactly the stale-chip-holder this exists to stop.
+    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT})
     try:
-        proc = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--inproc", platform],
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            timeout=ATTEMPT_TIMEOUT,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
+        _active_child = proc
+    finally:
+        signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGTERM, signal.SIGINT})
+    try:
+        stdout, _ = proc.communicate(timeout=ATTEMPT_TIMEOUT)
     except subprocess.TimeoutExpired:
-        return None
+        proc.kill()
+        proc.communicate()
+        return "timeout"
+    finally:
+        _active_child = None
     if proc.returncode != 0:
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
+    for line in reversed(stdout.strip().splitlines()):
         try:
             out = json.loads(line)
         except (json.JSONDecodeError, ValueError):
@@ -127,23 +153,49 @@ def _run_child(platform: str) -> dict | None:
     return None
 
 
+def _terminated(signum, frame):
+    # The driver's own timeout is killing us: reap the child so nothing
+    # keeps holding the TPU, emit a labeled line, exit cleanly.
+    if _active_child is not None:
+        try:
+            _active_child.kill()
+        except OSError:
+            pass
+    print(json.dumps({
+        "metric": "blake2b_hash_throughput_per_chip",
+        "value": 0,
+        "unit": "H/s",
+        "vs_baseline": 0.0,
+        "error": f"terminated by signal {signum} mid-measurement",
+    }), flush=True)
+    os._exit(0)
+
+
 def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--inproc":
         return _inproc(sys.argv[2])
+    signal.signal(signal.SIGTERM, _terminated)
+    signal.signal(signal.SIGINT, _terminated)
 
     result = _run_child("tpu")
     if result is None:
+        # Fast crash (stale chip holder, transient init error): one retry.
         time.sleep(RETRY_PAUSE)
         result = _run_child("tpu")
+    if result == "timeout":
+        # Hanging tunnel: a second full attempt would hang identically —
+        # go straight to the fallback so the total stays within the
+        # driver's budget.
+        result = None
     if result is not None and result.get("platform") == "cpu":
         # JAX resolved to CPU on its own: the measurement is already a valid
         # CPU number, just label it instead of re-measuring.
         result["note"] = "tpu unavailable; cpu fallback"
     elif result is None:
-        # TPU init failed/hung twice: labeled CPU-pinned fallback so the
-        # harness still records a number.
+        # TPU init failed/hung: labeled CPU-pinned fallback so the harness
+        # still records a number.
         cpu = _run_child("cpu")
-        if cpu is not None:
+        if isinstance(cpu, dict):
             cpu["note"] = "tpu unavailable; cpu fallback"
             result = cpu
     if result is None:
@@ -154,6 +206,10 @@ def main() -> int:
             "vs_baseline": 0.0,
             "error": "all measurement attempts failed or timed out",
         }
+    # A SIGTERM from here on must not append a value-0 line AFTER the real
+    # one — last-valid-JSON-line wins for any parser of this stdout.
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.SIG_DFL)
     print(json.dumps(result))
     return 0
 
