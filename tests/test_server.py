@@ -513,3 +513,64 @@ def test_throttler_fractional_rate_and_count_semantics():
         assert t10._capacity == 10 and t10._window == 60
 
     run(main())
+
+
+def test_impatient_waiter_teardown_does_not_break_dispatcher():
+    """Regression: while the dispatcher sits in its dispatch awaits (store
+    writes, publish) it is not yet registered as a waiter, so a concurrent
+    short-timeout waiter tearing the future down must not leave the
+    dispatcher looking up a dead map key (KeyError) — it falls into the
+    cancelled-future store-check and raises a clean retryable error."""
+    from tpu_dpow.server import RetryRequest
+
+    async def main():
+        async with Harness() as hx:
+            h = random_hash()
+            gate = asyncio.Event()
+            real_publish = hx.transport.publish
+
+            async def slow_publish(*a, **kw):
+                await gate.wait()
+                return await real_publish(*a, **kw)
+
+            hx.transport.publish = slow_publish
+            dispatcher = asyncio.ensure_future(
+                hx.server._dispatch_ondemand(h, None, EASY_BASE, timeout=5)
+            )
+            await asyncio.sleep(0.05)  # dispatcher now parked inside publish
+            assert h in hx.server.work_futures
+            waiter = asyncio.ensure_future(
+                hx.server._dispatch_ondemand(h, None, EASY_BASE, timeout=0.01)
+            )
+            with pytest.raises(RequestTimeout):
+                await waiter
+            # waiter's teardown removed + cancelled the shared future
+            assert h not in hx.server.work_futures
+            gate.set()  # dispatcher resumes: awaits its own cancelled future
+            with pytest.raises(RetryRequest):
+                await dispatcher
+
+    run(main())
+
+
+def test_concurrent_base_and_raised_dispatch_single_publish():
+    """Regression (TOCTOU): two dispatches racing for the same hash must not
+    both enter the dispatch block — the reservation is synchronous, so only
+    ONE work message is published and the loser just waits."""
+
+    async def main():
+        async with Harness() as hx:
+            await hx.start_worker()
+            h = random_hash()
+            raised = nc.derive_work_difficulty(1.5, EASY_BASE)
+            # the pre-state service_handler establishes before dispatching
+            await hx.store.set(f"block:{h}", WORK_PENDING)
+            a, b = await asyncio.gather(
+                hx.server._dispatch_ondemand(h, None, EASY_BASE, timeout=5),
+                hx.server._dispatch_ondemand(h, None, raised, timeout=5),
+            )
+            assert a == b
+            await asyncio.sleep(0.05)
+            assert len([m for m in hx.worker_log if m.topic.startswith("work/")]) == 1
+
+    run(main())

@@ -73,8 +73,10 @@ async def snapshot(store, *, min_works: int = MIN_WORKS, out_dir: str = ".",
         seed = await store.get(seed_key)
         if seed is None:
             seed = str(uuid.uuid4())
-            if not dry_run:
-                await store.set(seed_key, seed)
+            # Persisted in dry-run too (harmless metadata): otherwise a
+            # dry-run preview would mint throwaway seeds and its uuids could
+            # never match the real run's, defeating preview-then-pay.
+            await store.set(seed_key, seed)
         state = ":".join(
             f"{record.get(f'snapshot_{f}', 0)}" for f in WORK_FIELDS
         )

@@ -457,37 +457,64 @@ class DpowServer:
         difficulty: int,
         timeout: float,
     ) -> str:
+        created = None
         if block_hash not in self.work_futures:
-            if account:
-                asyncio.ensure_future(
-                    self.store.set(
-                        f"account:{account}", block_hash, expire=self.config.account_expiry
+            # Reserve the entry synchronously — no await sits between the
+            # membership check and this assignment — so concurrent base- and
+            # raised-difficulty dispatches for the same hash cannot both
+            # enter this block, double-publish, and clobber each other's
+            # block-difficulty entries (the base path's delete below would
+            # erase a raised entry and fail its final validation).
+            created = asyncio.get_running_loop().create_future()
+            self.work_futures[block_hash] = created
+            try:
+                if account:
+                    asyncio.ensure_future(
+                        self.store.set(
+                            f"account:{account}", block_hash, expire=self.config.account_expiry
+                        )
                     )
+                await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
+                                     expire=self.config.block_expiry)
+                if difficulty != self.config.base_difficulty:
+                    await self.store.set(
+                        f"block-difficulty:{block_hash}",
+                        f"{difficulty:016x}",
+                        expire=self.config.difficulty_expiry,
+                    )
+                else:
+                    # A previous raised-difficulty dispatch for this hash may
+                    # have timed out inside the 120 s TTL; its leftover entry
+                    # would make the result handler validate THIS base-difficulty
+                    # dispatch against the old higher target and discard valid
+                    # work. Clear it so validation matches what was asked for.
+                    await self.store.delete(f"block-difficulty:{block_hash}")
+                await self.transport.publish(
+                    "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
                 )
-            await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
-                                 expire=self.config.block_expiry)
-            if difficulty != self.config.base_difficulty:
-                await self.store.set(
-                    f"block-difficulty:{block_hash}",
-                    f"{difficulty:016x}",
-                    expire=self.config.difficulty_expiry,
-                )
-            else:
-                # A previous raised-difficulty dispatch for this hash may
-                # have timed out inside the 120 s TTL; its leftover entry
-                # would make the result handler validate THIS base-difficulty
-                # dispatch against the old higher target and discard valid
-                # work. Clear it so validation matches what was asked for.
-                await self.store.delete(f"block-difficulty:{block_hash}")
-            self.work_futures[block_hash] = asyncio.get_running_loop().create_future()
-            await self.transport.publish(
-                "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
-            )
+            except BaseException:
+                # A failed dispatch must not leave a never-resolved future
+                # that later requests for this hash would silently wait on.
+                # Identity-guarded: by the time this cleanup runs, a waiter's
+                # teardown may already have removed our future and a NEW
+                # dispatch installed its own — popping by key would destroy
+                # the successor's future out from under it.
+                if self.work_futures.get(block_hash) is created:
+                    del self.work_futures[block_hash]
+                if not created.done():
+                    created.cancel()
+                raise
+        # The dispatcher holds its OWN future: during its dispatch awaits it
+        # is not yet counted as a waiter, so an impatient concurrent waiter
+        # may have torn the map entry down already — a key lookup here would
+        # KeyError. Awaiting the (then-cancelled) `created` instead falls
+        # into the CancelledError store-check below, where a late-landing
+        # result is still honored. Non-dispatchers run no awaits between the
+        # membership check above and this line, so the key lookup is safe.
+        fut = created if created is not None else self.work_futures[block_hash]
         self._future_waiters[block_hash] = self._future_waiters.get(block_hash, 0) + 1
         try:
-            work = await asyncio.wait_for(
-                asyncio.shield(self.work_futures[block_hash]), timeout=timeout
-            )
+            work = await asyncio.wait_for(asyncio.shield(fut), timeout=timeout)
         except asyncio.CancelledError:
             # Future cancelled under us: the result may still have landed in
             # the store via client_result_handler (reference :340-345).
@@ -503,9 +530,14 @@ class DpowServer:
             remaining = self._future_waiters.get(block_hash, 1) - 1
             if remaining <= 0:
                 self._future_waiters.pop(block_hash, None)
-                future = self.work_futures.pop(block_hash, None)
-                if future is not None and not future.done():
-                    future.cancel()
+                # Identity-guarded: a waiter resumed late (e.g. out of the
+                # CancelledError store-check above) must only tear down the
+                # future IT awaited — by now the key may hold a successor
+                # dispatch's fresh future, which must stay.
+                if self.work_futures.get(block_hash) is fut:
+                    del self.work_futures[block_hash]
+                if not fut.done():
+                    fut.cancel()
             else:
                 self._future_waiters[block_hash] = remaining
         return work

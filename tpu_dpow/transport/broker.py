@@ -114,7 +114,15 @@ class Broker:
     def user_for(self, session: Session) -> User:
         if self.users is None:
             return User(password="")
-        return self.users[session.username]
+        user = self.users.get(session.username)
+        if user is None:
+            # Removed from the ACL table mid-session (durable sessions
+            # outlive ACL edits): deny-all, never KeyError — a raw KeyError
+            # here would escape the AuthError handling in every caller
+            # (publish/subscribe crash the connection task, delivery aborts
+            # for all later targets).
+            return User(password="", acl_pub=(), acl_sub=())
+        return user
 
     def subscribe(self, session: Session, pattern: str, qos: int) -> None:
         if not self.user_for(session).may_subscribe(pattern):
@@ -137,7 +145,8 @@ class Broker:
             if self.users is not None and not self.user_for(target).may_receive(topic):
                 # Per-message read ACL, as mosquitto enforces it: a
                 # subscription that slipped past (or predates) the
-                # subscribe-time check still never leaks messages.
+                # subscribe-time check — or belongs to a user since removed
+                # from the ACL table — still never leaks messages.
                 self.stats["denied"] += 1
                 continue
             # Effective QoS = min(publish qos, subscription qos), per MQTT.

@@ -178,6 +178,11 @@ class MqttTransport(TcpTransport):
         self._sub_mids: dict = {}  # pending SUBSCRIBE mid → pattern
 
     async def _connect_once(self) -> None:
+        # Mids from SUBSCRIBEs whose SUBACK never arrived died with the old
+        # connection — reconnect replays subscriptions under fresh mids, and
+        # the replayed SUBACKs resolve waits by pattern, so stale entries
+        # would only leak and could mis-resolve after the 16-bit mid wraps.
+        self._sub_mids.clear()
         await super()._connect_once()
         if self._ping_task is None or self._ping_task.done():
             self._ping_task = asyncio.ensure_future(self._ping_loop())
