@@ -455,3 +455,102 @@ def test_jax_backend_rejects_oversize_window_at_construction():
 
     with pytest.raises(WorkError, match="2\\^31"):
         JaxWorkBackend(kernel="pallas", sublanes=32, iters=4096, nblocks=128)
+
+
+# -- launch pipelining --------------------------------------------------------
+
+
+def test_pipeline_overlaps_launches():
+    """With pipeline=2, a second launch must be dispatched while the first is
+    still executing — observed via a barrier both launch threads must reach
+    concurrently (a serialized engine would deadlock the barrier and time
+    out)."""
+    import threading
+
+    b = make_backend(pipeline=2)
+    barrier = threading.Barrier(2, timeout=10)
+    overlapped = []
+    real_launch = b._launch
+
+    def instrumented(params, steps):
+        try:
+            barrier.wait(timeout=5)
+            overlapped.append(True)
+        except threading.BrokenBarrierError:
+            pass  # solo launch (e.g. first pass before the pipe fills)
+        return real_launch(params, steps)
+
+    b._launch = instrumented
+
+    async def run():
+        # Unreachable difficulty keeps the job scanning across many launches.
+        hard = WorkRequest(random_hash(), (1 << 64) - 1)
+        task = asyncio.ensure_future(b.generate(hard))
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if overlapped:
+                break
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, WorkCancelled):
+            pass
+        await b.close()
+        assert overlapped, "no two launches were ever in flight concurrently"
+
+    asyncio.run(run())
+
+
+def test_pipeline_speculative_bases_disjoint():
+    """Consecutive pipelined launches for one unsolved job must scan
+    consecutive disjoint spans (the speculative base advance), never the
+    same window twice."""
+    from tpu_dpow.ops import search
+
+    b = make_backend(pipeline=2)
+    seen = []
+    real_launch = b._launch
+
+    def recording(params, steps):
+        seen.append((int(params[0, search.BASE_HI]) << 32)
+                    | int(params[0, search.BASE_LO]))
+        return real_launch(params, steps)
+
+    b._launch = recording
+
+    async def run():
+        hard = WorkRequest(random_hash(), (1 << 64) - 1)
+        task = asyncio.ensure_future(b.generate(hard))
+        while len(seen) < 6:
+            await asyncio.sleep(0.01)
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, WorkCancelled):
+            pass
+        await b.close()
+
+    asyncio.run(run())
+    # Drop the setup() self-test probes (base 0 or tiny); the job's bases
+    # start at its random 64-bit offset and step by exactly one span.
+    span = b.chunk * b.run_steps if b.run_steps else b.chunk
+    job_bases = seen[-6:]
+    deltas = {(b2 - b1) & ((1 << 64) - 1) for b1, b2 in zip(job_bases, job_bases[1:])}
+    assert len(deltas) == 1, f"non-uniform span advance: {deltas}"
+    assert deltas.pop() % b.chunk == 0
+
+
+def test_pipeline_solve_correct_under_speculation(backend):
+    """A solvable job under pipeline=2 still returns valid work and the
+    speculative successor launch's result for the solved row is discarded."""
+
+    async def run():
+        b = make_backend(pipeline=2)
+        await b.setup()
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(4)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        await b.close()
+
+    asyncio.run(run())

@@ -32,6 +32,12 @@ in-process engine built on the chunk scanners in ops/:
     — ops/runloop.py — is equivalent on local hardware, but through a
     remote-chip tunnel each loop iteration costs a full host round trip,
     so the engine prefers one wide grid.)
+  * Launch pipelining (``pipeline``, default 2) keeps a second launch in
+    flight while the first's results travel back: jobs advance their scan
+    base speculatively at dispatch, so consecutive launches cover disjoint
+    spans and the device never idles through host readback/repack — the
+    round-2 flood benchmark lost ~27% of the device solve ceiling to that
+    bubble.
 
 Every found nonce is re-validated on host against hashlib before being
 returned (the belt to the device's suspenders, mirroring the reference's
@@ -79,6 +85,18 @@ class _Job:
         self.params[search.DIFF_HI] = difficulty >> 32
 
 
+@dataclass
+class _Launch:
+    """One in-flight device launch and the per-job state it was packed with."""
+
+    fut: asyncio.Future  # executor future → (lo, hi) result arrays
+    jobs: list  # the _Jobs occupying the first len(jobs) batch rows
+    launched_difficulty: list  # per-job target snapshot at dispatch
+    bases: list  # per-job scan base at dispatch (pre-speculation)
+    span: int  # nonces scanned per row this launch
+    shape: tuple  # (batch, steps) — warmed on success
+
+
 class JaxWorkBackend(WorkBackend):
     """Batched chunked nonce search on this host's jax.local_devices().
 
@@ -106,6 +124,7 @@ class JaxWorkBackend(WorkBackend):
         run_steps: Optional[int] = None,  # cap on windows per device launch
         warm_shapes: Optional[bool] = None,  # background-compile launch shapes
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
+        pipeline: int = 2,  # launches in flight at once (1 = no overlap)
     ):
         if mesh_devices > 1:
             # local_devices: under a jax.distributed multi-host slice the
@@ -179,6 +198,17 @@ class JaxWorkBackend(WorkBackend):
         if launch_timeout is None:
             launch_timeout = 300.0 if on_tpu else None
         self.launch_timeout = launch_timeout
+        # Launch pipelining: the engine keeps up to ``pipeline`` launches in
+        # flight, overlapping host readback + repacking of launch N with
+        # device execution of launch N+1 — without it the device idles for a
+        # full tunnel round trip between launches and every queued request
+        # eats that bubble. Jobs included in a successor launch advance
+        # their base SPECULATIVELY at dispatch (assuming the predecessor
+        # misses); a predecessor hit just resolves the job and the
+        # successor's now-useless lane result is discarded, identical to the
+        # cancel-in-flight race. Worst-case cancel latency grows to
+        # pipeline * run_steps windows.
+        self.pipeline = max(1, pipeline)
         self._warm: set = set()
         self._warm_task: Optional[asyncio.Task] = None
         # Dedicated launch executor (2 workers: one engine launch + one warm
@@ -397,14 +427,19 @@ class JaxWorkBackend(WorkBackend):
                 return steps
         return self.run_steps
 
-    async def _timed_launch(self, params_batch: np.ndarray, steps: int) -> tuple:
-        """_launch off the event loop, bounded by launch_timeout."""
+    def _submit_launch(self, params_batch: np.ndarray, steps: int) -> asyncio.Future:
+        """Hand a launch to the executor; device work starts immediately."""
         if self._executor is None:
             import concurrent.futures
 
-            self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+            # pipeline launch threads + one for warm compiles.
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.pipeline + 1
+            )
         loop = asyncio.get_running_loop()
-        fut = loop.run_in_executor(self._executor, self._launch, params_batch, steps)
+        return loop.run_in_executor(self._executor, self._launch, params_batch, steps)
+
+    async def _await_launch(self, fut: asyncio.Future, shape_note: str) -> tuple:
         if self.launch_timeout is None:
             return await fut
         try:
@@ -412,14 +447,21 @@ class JaxWorkBackend(WorkBackend):
         except asyncio.TimeoutError:
             # The wedged thread cannot be killed; abandon the whole executor
             # so later launches get fresh workers instead of queueing behind
-            # the stuck one.
+            # the stuck one. (Other in-flight launches on it are presumed
+            # wedged on the same tunnel and abandoned with it.)
             self._executor.shutdown(wait=False)
             self._executor = None
             raise WorkError(
                 f"device launch exceeded {self.launch_timeout:.0f}s "
-                f"(batch={params_batch.shape[0]}, steps={steps}) — "
-                "tunnel or device hang"
+                f"({shape_note}) — tunnel or device hang"
             )
+
+    async def _timed_launch(self, params_batch: np.ndarray, steps: int) -> tuple:
+        """_launch off the event loop, bounded by launch_timeout."""
+        return await self._await_launch(
+            self._submit_launch(params_batch, steps),
+            f"batch={params_batch.shape[0]}, steps={steps}",
+        )
 
     def _launch(self, params_batch: np.ndarray, steps: int) -> tuple:
         """One blocking batched device launch (called via to_thread).
@@ -521,73 +563,117 @@ class JaxWorkBackend(WorkBackend):
             self._jobs.clear()
             raise
 
-    async def _engine_loop_inner(self) -> None:
-        while not self._closed:
-            self._gc_jobs()
-            if not self._jobs:
-                self._wakeup.clear()
-                try:
-                    await asyncio.wait_for(self._wakeup.wait(), timeout=5.0)
-                except asyncio.TimeoutError:
-                    # A job may have landed exactly at the deadline (set()
-                    # and the timeout can race); only die truly idle.
-                    if not self._jobs:
-                        return
+    def _dispatch_next(self) -> "Optional[_Launch]":
+        """Pack and submit one launch for the next difficulty rung, or None
+        when no uncancelled jobs exist.
+
+        Difficulty-adaptive run length, decoupled across difficulty
+        classes: jobs are grouped into rungs by the run length their
+        difficulty wants, and each launch serves ONE rung (round-robin), so
+        a hard request's wide launch never stretches every easy request's
+        pass — and easy floods can't starve the hard rung either. Batch and
+        steps then clamp to warmed shapes.
+
+        Each included job's base advances SPECULATIVELY here, so a
+        successor launch dispatched while this one is still in flight scans
+        the NEXT span instead of re-scanning this one.
+        """
+        self._gc_jobs()
+        alive = [j for j in self._jobs.values() if not j.cancelled]
+        if not alive:
+            return None
+        rungs: Dict[int, list] = {}
+        for j in alive:
+            rungs.setdefault(self._steps_for(j.difficulty), []).append(j)
+        steps_want = self._next_rung(rungs)
+        active = rungs[steps_want][: self.max_batch]
+        b, steps = self._pick_shape(len(active), steps_want)
+        active = active[:b]
+        params = self._pack(active, b)
+        span = self.chunk * steps
+        rec = _Launch(
+            fut=self._submit_launch(params, steps),
+            jobs=active,
+            # Snapshot targets and bases at launch: a concurrent dedup may
+            # raise job.difficulty, and a pipelined successor dispatch will
+            # advance job.base, while this chunk is in flight.
+            launched_difficulty=[j.difficulty for j in active],
+            bases=[j.base for j in active],
+            span=span,
+            shape=(params.shape[0], steps),
+        )
+        for job in active:
+            job.set_base(job.base + span)
+        return rec
+
+    def _apply_results(self, rec: "_Launch", lo_arr, hi_arr) -> None:
+        self._warm.add(rec.shape)  # organic warming
+        for job, launched, base, lo, hi in zip(
+            rec.jobs, rec.launched_difficulty, rec.bases,
+            lo_arr[: len(rec.jobs)], hi_arr[: len(rec.jobs)],
+        ):
+            nonce = (int(hi) << 32) | int(lo)
+            if nonce == _MASK64:  # span exhausted without a hit
+                self.total_hashes += rec.span
+                # base already advanced at dispatch — exactly the miss case
+                # the speculation assumed.
                 continue
-            alive = [j for j in self._jobs.values() if not j.cancelled]
-            if not alive:
+            scanned = ((nonce - base) & _MASK64) + 1
+            self.total_hashes += scanned
+            if job.future.done():
+                continue  # cancelled/solved while the launch was in flight: drop
+            work = search.work_hex_from_nonce(nonce)
+            value = nc.work_value(job.block_hash, work)
+            if value >= job.difficulty:
+                self.total_solutions += 1
+                job.future.set_result(work)
+            elif value >= launched:
+                # Valid for the difficulty this chunk was launched at,
+                # but the target was raised mid-flight: keep searching
+                # past this nonce at the new difficulty. (An in-flight
+                # successor still scans its speculative span at the old
+                # target; a weaker hit there just lands back in this branch.)
+                job.set_base(nonce + 1)
+            else:  # device/host disagreement: a real bug, surface it
+                job.future.set_exception(
+                    WorkError(
+                        f"device produced invalid work {work} for "
+                        f"{job.block_hash} (value {value:016x} < {launched:016x})"
+                    )
+                )
+
+    async def _engine_loop_inner(self) -> None:
+        from collections import deque
+
+        inflight: deque = deque()
+        while not self._closed:
+            if not inflight:
+                self._gc_jobs()
+                if not self._jobs:
+                    self._wakeup.clear()
+                    try:
+                        await asyncio.wait_for(self._wakeup.wait(), timeout=5.0)
+                    except asyncio.TimeoutError:
+                        # A job may have landed exactly at the deadline (set()
+                        # and the timeout can race); only die truly idle.
+                        if not self._jobs:
+                            return
+                    continue
+            # Keep up to ``pipeline`` launches in flight: the device starts
+            # on launch N+1 while launch N's results are still in transit.
+            while len(inflight) < self.pipeline:
+                rec = self._dispatch_next()
+                if rec is None:
+                    break
+                inflight.append(rec)
+            if not inflight:
                 await asyncio.sleep(0)  # cancelled stragglers gc'd next pass
                 continue
-            # Difficulty-adaptive run length, decoupled across difficulty
-            # classes: jobs are grouped into rungs by the run length their
-            # difficulty wants, and each engine pass launches ONE rung
-            # (round-robin), so a hard request's wide launch never stretches
-            # every easy request's pass — and easy floods can't starve the
-            # hard rung either. Batch and steps then clamp to warmed shapes.
-            rungs: Dict[int, list] = {}
-            for j in alive:
-                rungs.setdefault(self._steps_for(j.difficulty), []).append(j)
-            steps_want = self._next_rung(rungs)
-            active = rungs[steps_want][: self.max_batch]
-            b, steps = self._pick_shape(len(active), steps_want)
-            active = active[:b]
-            params = self._pack(active, b)
-            span = self.chunk * steps
-            # Snapshot each job's target at launch: a concurrent dedup may
-            # raise job.difficulty while this chunk is in flight.
-            launched_difficulty = [j.difficulty for j in active]
-            lo_arr, hi_arr = await self._timed_launch(params, steps)
-            self._warm.add((params.shape[0], steps))  # organic warming
-            for job, launched, lo, hi in zip(
-                active, launched_difficulty, lo_arr[: len(active)], hi_arr[: len(active)]
-            ):
-                nonce = (int(hi) << 32) | int(lo)
-                if nonce == _MASK64:  # span exhausted without a hit
-                    self.total_hashes += span
-                    if not job.future.done():
-                        job.set_base(job.base + span)
-                    continue
-                scanned = ((nonce - job.base) & _MASK64) + 1
-                self.total_hashes += scanned
-                if job.future.done():
-                    continue  # cancelled while the launch was in flight: drop
-                work = search.work_hex_from_nonce(nonce)
-                value = nc.work_value(job.block_hash, work)
-                if value >= job.difficulty:
-                    self.total_solutions += 1
-                    job.future.set_result(work)
-                elif value >= launched:
-                    # Valid for the difficulty this chunk was launched at,
-                    # but the target was raised mid-flight: keep searching
-                    # past this nonce at the new difficulty.
-                    job.set_base(nonce + 1)
-                else:  # device/host disagreement: a real bug, surface it
-                    job.future.set_exception(
-                        WorkError(
-                            f"device produced invalid work {work} for "
-                            f"{job.block_hash} (value {value:016x} < {launched:016x})"
-                        )
-                    )
+            rec = inflight.popleft()
+            lo_arr, hi_arr = await self._await_launch(
+                rec.fut, f"batch={rec.shape[0]}, steps={rec.shape[1]}"
+            )
+            self._apply_results(rec, lo_arr, hi_arr)
 
     def _gc_jobs(self) -> None:
         for key in [k for k, j in self._jobs.items() if j.future.done()]:

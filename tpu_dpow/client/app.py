@@ -54,6 +54,8 @@ class DpowClient:
                 kwargs["mesh_devices"] = config.mesh_devices
                 if config.run_steps > 0:
                     kwargs["run_steps"] = config.run_steps
+                if config.pipeline > 0:
+                    kwargs["pipeline"] = config.pipeline
             backend = get_backend(config.backend, **kwargs)
         # The handler's in-flight cap must exceed the engine's batch size or
         # the batched launch can never fill (the queue would starve it at 8

@@ -23,6 +23,7 @@ class ClientConfig:
     max_batch: int = 16
     mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
+    pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
     work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
     client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
     log_file: Optional[str] = None
@@ -30,6 +31,8 @@ class ClientConfig:
     def __post_init__(self):
         if self.run_steps < 0:
             raise ValueError("--run_steps must be >= 0 (0 = auto)")
+        if self.pipeline < 0:
+            raise ValueError("--pipeline must be >= 0 (0 = auto)")
         if self.payout_address:
             self.payout_address = self.payout_address.replace("xrb_", "nano_")
             nc.validate_account(self.payout_address)
@@ -60,6 +63,10 @@ def parse_args(argv=None) -> ClientConfig:
                    "auto: device-resident runs on TPU, single windows "
                    "elsewhere; higher = less dispatch overhead, coarser "
                    "cancel latency)")
+    p.add_argument("--pipeline", type=int, default=c.pipeline,
+                   help="device launches in flight at once (backend=jax; "
+                   "0 = auto: 2 — overlaps readback of one launch with "
+                   "device execution of the next; 1 disables the overlap)")
     p.add_argument("--work_concurrency", type=int, default=c.work_concurrency,
                    help="work items in flight at once (0 = auto: 2*max_batch "
                    "for the jax backend, 8 otherwise)")
