@@ -1,0 +1,99 @@
+"""Subprocess body for the REAL multi-process multihost test.
+
+Launched twice by tests/test_multihost_procs.py with TPU_DPOW_COORDINATOR /
+TPU_DPOW_NUM_PROCESSES / TPU_DPOW_PROCESS_ID in the env — the same env
+contract the production entrypoints honor (parallel/multihost.py
+init_distributed). Each process brings 4 virtual CPU devices, so
+``jax.distributed`` assembles a genuine 2-host x 4-chip global topology:
+``make_multihost_mesh`` must put the batch axis across processes (DCN) and
+the nonce axis within each process (ICI), and ``sharded_search_run`` must
+produce hashlib-valid nonces in BOTH processes.
+
+This is the pod-scale analog of the reference's multi-node operation
+(reference README.md:21 — there, independent MQTT clients; here, one SPMD
+worker spanning hosts).
+
+Prints one JSON line: {"process_id": N, "rows": {row_index: nonce_hex}} and
+exits 0 on success; any assertion failure exits nonzero.
+"""
+
+import hashlib
+import json
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIFFICULTY = 0xFFF0000000000000  # ~1 in 4096 nonces: solves in one window
+
+
+def main() -> int:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+
+    from tpu_dpow.parallel import multihost
+    from tpu_dpow.parallel.mesh_search import (
+        BATCH_AXIS,
+        NONCE_AXIS,
+        replicate_params,
+        sharded_search_run,
+    )
+    from tpu_dpow.ops import search
+
+    multihost.init_distributed()  # reads the TPU_DPOW_* env contract
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    mesh = multihost.make_multihost_mesh()
+    # Topology rule: batch axis == hosts (DCN-allowed), nonce axis == one
+    # host's local chips (the per-launch pmin stays intra-process).
+    assert mesh.shape[BATCH_AXIS] == 2 and mesh.shape[NONCE_AXIS] == 4
+    for host_row in range(2):
+        procs = {d.process_index for d in mesh.devices[host_row]}
+        assert len(procs) == 1, f"nonce axis crosses hosts: {procs}"
+
+    # Same (seeded) request batch in every process — SPMD requires the
+    # global array to agree; one request row lands on each host.
+    rng = np.random.default_rng(int(os.environ["TEST_SEED"]))
+    hashes = [rng.bytes(32) for _ in range(2)]
+    params = np.stack([search.pack_params(h, DIFFICULTY, 0) for h in hashes])
+
+    pj = replicate_params(params, mesh)
+    lo, hi = sharded_search_run(
+        pj, mesh=mesh, chunk_per_shard=4096, max_steps=8
+    )
+
+    # Each process validates the row(s) it can address (its own host's
+    # shard of the batch axis) against hashlib — the host-side truth.
+    rows = {}
+    for s_lo, s_hi in zip(lo.addressable_shards, hi.addressable_shards):
+        start = s_lo.index[0].start or 0
+        for off, (l, h) in enumerate(
+            zip(np.asarray(s_lo.data), np.asarray(s_hi.data))
+        ):
+            row = start + off
+            nonce = (int(h) << 32) | int(l)
+            assert nonce != (1 << 64) - 1, f"row {row} unsolved"
+            digest = hashlib.blake2b(
+                struct.pack("<Q", nonce) + hashes[row], digest_size=8
+            ).digest()
+            value = int.from_bytes(digest, "little")
+            assert value >= DIFFICULTY, f"row {row}: {value:016x}"
+            rows[str(row)] = f"{nonce:016x}"
+    assert rows, "process addressed no batch rows"
+
+    print(json.dumps({
+        "process_id": jax.process_index(),
+        "rows": rows,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
