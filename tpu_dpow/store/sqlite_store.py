@@ -134,6 +134,9 @@ class SqliteStore(Store):
     # -- kv --------------------------------------------------------------
 
     async def get(self, key: str) -> Optional[str]:
+        # Reads enforce the one-key-one-type rule too (MemoryStore raises on
+        # get of a hash key; Redis raises WRONGTYPE even for reads).
+        self._expect_type(key, "kv")
         return self._get_row(key)
 
     async def set(self, key: str, value: str, expire: Optional[float] = None) -> None:
@@ -212,12 +215,14 @@ class SqliteStore(Store):
         self._commit()
 
     async def hget(self, key: str, field: str) -> Optional[str]:
+        self._expect_type(key, "hashes")
         row = self._db.execute(
             "SELECT value FROM hashes WHERE key = ? AND field = ?", (key, field)
         ).fetchone()
         return row[0] if row else None
 
     async def hgetall(self, key: str) -> Dict[str, str]:
+        self._expect_type(key, "hashes")
         return dict(
             self._db.execute(
                 "SELECT field, value FROM hashes WHERE key = ?", (key,)
@@ -241,6 +246,7 @@ class SqliteStore(Store):
         self._commit()
 
     async def srem(self, key: str, *members: str) -> None:
+        self._expect_type(key, "sets_")
         for m in members:
             self._db.execute(
                 "DELETE FROM sets_ WHERE key = ? AND member = ?", (key, m)
@@ -248,6 +254,7 @@ class SqliteStore(Store):
         self._commit()
 
     async def smembers(self, key: str) -> set:
+        self._expect_type(key, "sets_")
         return {
             row[0]
             for row in self._db.execute(
