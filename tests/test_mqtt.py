@@ -467,3 +467,90 @@ def test_broker_face_survives_garbage_connections():
             await srv.stop()
 
     run(main())
+
+
+def test_mqtt_qos1_redelivered_when_dropped_before_puback():
+    """Per-packet at-least-once OUT of the broker: a QoS-1 PUBLISH whose
+    connection dies between delivery and PUBACK is redelivered (dup=1) when
+    the durable session reconnects — the Mosquitto behavior the reference's
+    client depends on for cancels (reference client/dpow_client.py:143-147).
+    Round-2 gap: only messages queued *while disconnected* were replayed."""
+
+    async def raw_connect(port, client_id):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(mc.encode(mc.Connect(
+            client_id=client_id, clean_session=False, keepalive=60
+        )))
+        await writer.drain()
+        connack = await mc.read_packet(reader)
+        assert isinstance(connack, mc.Connack)
+        return reader, writer
+
+    async def main():
+        srv = await _start_broker()
+        try:
+            # Durable raw client subscribes cancel/# at QoS 1.
+            reader, writer = await raw_connect(srv.port, "rawworker")
+            writer.write(mc.encode(mc.Subscribe(mid=1, topics=[("cancel/#", 1)])))
+            await writer.drain()
+            assert isinstance(await mc.read_packet(reader), mc.Suback)
+
+            pub = MqttTransport(port=srv.port, client_id="pub1")
+            await pub.connect()
+            await pub.publish("cancel/ondemand", "CAFEBABE", QOS_1)
+
+            first = await asyncio.wait_for(mc.read_packet(reader), 5)
+            assert isinstance(first, mc.Publish)
+            assert first.qos == 1 and first.payload == b"CAFEBABE"
+            # Cut the connection WITHOUT sending PUBACK.
+            writer.close()
+            await asyncio.sleep(0.05)
+
+            # Reconnect: the un-acked PUBLISH must come again, dup set.
+            reader, writer = await raw_connect(srv.port, "rawworker")
+            again = await asyncio.wait_for(mc.read_packet(reader), 5)
+            assert isinstance(again, mc.Publish)
+            assert again.payload == b"CAFEBABE" and again.qos == 1
+            assert again.dup is True
+            # Ack it this time; after a clean disconnect + reconnect there
+            # must be NO further redelivery.
+            writer.write(mc.encode(mc.Puback(mid=again.mid)))
+            writer.write(mc.encode(mc.Disconnect()))
+            await writer.drain()
+            await asyncio.sleep(0.05)
+            writer.close()
+
+            reader, writer = await raw_connect(srv.port, "rawworker")
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(mc.read_packet(reader), 0.2)
+            writer.close()
+            await pub.close()
+        finally:
+            await srv.stop()
+
+    run(main())
+
+
+def test_mqtt_qos1_undelivered_queue_remnant_survives_disconnect():
+    """Messages already routed into a durable session's live queue — but not
+    yet written to the socket — survive a disconnect and are replayed on
+    reconnect (broker._salvage path)."""
+
+    async def main():
+        broker = Broker()
+        sess = broker.attach("w", "", "", clean_session=False)
+        broker.subscribe(sess, "cancel/#", 1)
+        # Simulate the pump never draining: publish lands in the queue,
+        # then the connection detaches.
+        broker.publish(None, "cancel/ondemand", "H1", 1)
+        broker.publish(None, "cancel/ondemand", "H0", 0)  # QoS-0: dropped
+        broker.detach(sess)
+        assert [m.payload for m in sess.offline] == ["H1"]
+        assert sess.offline[0].dup is True
+
+        sess2 = broker.attach("w", "", "", clean_session=False)
+        assert sess2 is sess
+        replayed = sess2.queue.get_nowait()
+        assert (replayed.topic, replayed.payload) == ("cancel/ondemand", "H1")
+
+    run(main())

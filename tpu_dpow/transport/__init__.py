@@ -36,6 +36,7 @@ class Message:
     topic: str
     payload: str
     qos: int = QOS_0
+    dup: bool = False  # redelivery of a possibly-already-seen QoS-1 message
 
 
 class TransportError(Exception):

@@ -90,8 +90,18 @@ class Broker:
             session = Session(client_id=client_id, username=username, clean=clean_session)
             self.sessions[client_id] = session
         session.username = username
+        if session.queue is not None:
+            # Takeover with undelivered messages still queued: QoS-1 ones
+            # must survive into the new connection (at-least-once), not die
+            # with the old pump. Drain is safe against the old pump — both
+            # run on the event loop thread and the pump is parked in get().
+            # Null the queue first so the salvage lands in offline (replayed
+            # into the NEW queue just below), not back into the dying one.
+            old_queue, session.queue = session.queue, None
+            self._salvage(session, old_queue)
         session.queue = asyncio.Queue(maxsize=MAX_QUEUE)
-        # Replay QoS-1 messages queued while this session was offline.
+        # Replay QoS-1 messages queued while this session was offline (or
+        # salvaged from a taken-over/detached connection), oldest first.
         for msg in session.offline:
             self._enqueue(session, msg)
         session.offline.clear()
@@ -102,12 +112,65 @@ class Broker:
             # Stale detach from a taken-over connection: the session now
             # belongs to a newer connection — don't null ITS queue.
             return
+        if session.queue is not None:
+            # QoS-1 messages the pump never got to send survive the
+            # disconnect for durable sessions (the same at-least-once
+            # promise Mosquitto keeps; QoS-0 and clean sessions drop).
+            # Queue nulled first so the salvage lands in offline.
+            old_queue, session.queue = session.queue, None
+            self._salvage(session, old_queue)
         session.queue = None
         # Only drop the registry entry if it is still THIS session: after a
         # clean-session takeover the id maps to the new connection's Session,
         # which must keep receiving messages.
         if session.clean and self.sessions.get(session.client_id) is session:
             self.sessions.pop(session.client_id, None)
+
+    def _salvage(self, session: Session, queue: asyncio.Queue) -> None:
+        """Move a dying queue's undelivered QoS-1 messages into the
+        session's offline list (durable sessions only)."""
+        kept = []
+        while True:
+            try:
+                msg = queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if msg is None:
+                continue  # poison pill from an earlier takeover
+            if msg.qos >= QOS_1 and not session.clean:
+                kept.append(msg)
+            else:
+                self.stats["dropped"] += 1
+        if kept:
+            self.requeue(session, kept)
+
+    def requeue(self, session: Session, messages: list) -> None:
+        """Return QoS-1 messages for redelivery (sent-but-unacked from a
+        protocol face, or undelivered remnants via _salvage).
+
+        Oldest-first ``messages`` are PREPENDED to the offline list — they
+        predate anything published after the disconnect — and marked dup,
+        matching Mosquitto's retransmission flag. If the session already
+        reattached (takeover finished before the old face's teardown ran),
+        deliver straight into the live queue instead.
+        """
+        redeliveries = [
+            Message(topic=m.topic, payload=m.payload, qos=m.qos, dup=True)
+            for m in messages
+        ]
+        if session.queue is not None:
+            for msg in redeliveries:
+                self._enqueue(session, msg)
+            return
+        if session.clean:
+            self.stats["dropped"] += len(redeliveries)
+            return
+        session.offline[:0] = redeliveries
+        overflow = len(session.offline) - MAX_OFFLINE_QUEUE
+        if overflow > 0:
+            # Same shed policy as publish(): drop oldest first.
+            del session.offline[:overflow]
+            self.stats["dropped"] += overflow
 
     # -- pub/sub -------------------------------------------------------
 

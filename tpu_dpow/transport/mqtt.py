@@ -55,6 +55,12 @@ async def handle_mqtt_conn(
     my_queue = None  # the queue THIS connection installed at attach
     pump: Optional[asyncio.Task] = None
     out_mid = itertools.count(1)
+    # Outbound QoS-1 PUBLISHes awaiting the client's PUBACK, mid → Message
+    # (insertion-ordered). Whatever is still here when the connection dies
+    # is requeued for redelivery — the per-packet at-least-once leg that
+    # the reference's client depends on from Mosquitto for cancels
+    # (reference client/dpow_client.py:143-147).
+    unacked: dict = {}
 
     def send(pkt) -> None:
         writer.write(mc.encode(pkt))
@@ -68,13 +74,19 @@ async def handle_mqtt_conn(
                 msg = await queue.get()
                 if msg is None:
                     break
+                mid = None
+                if msg.qos > 0:
+                    mid = next(out_mid) % 65000 + 1  # u16, nonzero: wrap
+                    # Record BEFORE the write: a drop inside drain() must
+                    # still count this message as outstanding.
+                    unacked[mid] = msg
                 send(
                     mc.Publish(
                         topic=msg.topic,
                         payload=msg.payload.encode("utf-8"),
                         qos=msg.qos,
-                        # MQTT packet ids are u16 and nonzero: wrap.
-                        mid=(next(out_mid) % 65000 + 1) if msg.qos > 0 else None,
+                        mid=mid,
+                        dup=msg.dup,
                     )
                 )
                 await writer.drain()
@@ -116,6 +128,8 @@ async def handle_mqtt_conn(
                 break
             if isinstance(pkt, mc.Pingreq):
                 send(mc.Pingresp())
+            elif isinstance(pkt, mc.Puback):
+                unacked.pop(pkt.mid, None)
             elif isinstance(pkt, mc.Publish):
                 payload = pkt.payload.decode("utf-8", errors="replace")
                 try:
@@ -153,6 +167,10 @@ async def handle_mqtt_conn(
             pump.cancel()
         if session is not None:
             broker.detach(session, my_queue)
+            if unacked:
+                # Sent-but-unacked QoS-1 deliveries go back FIRST (they are
+                # older than the queue remnant detach just salvaged).
+                broker.requeue(session, list(unacked.values()))
 
 
 class MqttTransport(TcpTransport):
