@@ -554,3 +554,99 @@ def test_mqtt_qos1_undelivered_queue_remnant_survives_disconnect():
         assert (replayed.topic, replayed.payload) == ("cancel/ondemand", "H1")
 
     run(main())
+
+
+def test_mqtt_transport_stock_broker_golden_interop():
+    """MqttTransport against a scripted byte-level 'Mosquitto': every byte
+    the transport emits over a full subscribe → work → result/PUBACK cycle
+    is pinned against hand-derived MQTT 3.1.1 spec bytes, and the broker
+    side of the dialogue is raw spec bytes too (never this repo's encoder)
+    — so this passes exactly iff a stock MQTT 3.1.1 broker would accept the
+    session. (paho/mosquitto are not installable here; this is the
+    wire-golden fallback. Reference deployment: external Mosquitto,
+    server/setup/mosquitto; ours: setup/mosquitto/tpu-dpow.conf.)"""
+
+    # -- hand-derived spec bytes (MQTT 3.1.1, OASIS §3) --------------------
+    CONNECT = bytes.fromhex(
+        "10" "1a"              # CONNECT, remaining 26
+        "0004" "4d515454" "04" # "MQTT" level 4
+        "c2"                   # flags: username|password|clean
+        "003c"                 # keepalive 60
+        "0002" "7731"          # client id "w1"
+        "0006" "636c69656e74"  # username "client"
+        "0002" "7077"          # password "pw"
+    )
+    CONNACK = bytes.fromhex("20" "02" "00" "00")
+    SUBSCRIBE = bytes.fromhex(
+        "82" "0b"              # SUBSCRIBE (flags 0b0010), remaining 11
+        "0002"                 # mid 2 (transport's sub-mid counter)
+        "0006" "776f726b2f23"  # "work/#"
+        "01"                   # requested QoS 1
+    )
+    SUBACK = bytes.fromhex("90" "03" "0002" "01")  # mid 2, granted QoS 1
+    WORK_PUBLISH = bytes.fromhex(
+        "32" "18"                      # PUBLISH QoS1, remaining 24
+        "000d" + b"work/ondemand".hex()  # topic
+        + "0005"                       # mid 5
+        + b"AB,cafe".hex()             # payload
+    )
+    WORK_PUBACK = bytes.fromhex("40" "02" "0005")
+    RESULT_PUBLISH = bytes.fromhex(
+        "32" "1a"                        # PUBLISH QoS1, remaining 26
+        "000f" + b"result/ondemand".hex()
+        + "0002"                         # transport's first publish mid (1-based counter, +1 wrap)
+        + b"AB,beef".hex()
+    )
+    RESULT_PUBACK = bytes.fromhex("40" "02" "0002")
+
+    mismatches = []
+    done = None  # created inside main (needs the running loop)
+    first_conn = [True]
+
+    async def exact_read(reader, expected, what):
+        got = await asyncio.wait_for(reader.readexactly(len(expected)), 5)
+        if got != expected:
+            mismatches.append(f"{what}: {got.hex()} != {expected.hex()}")
+
+    async def fake_mosquitto(reader, writer):
+        if not first_conn[0]:
+            writer.close()  # auto-reconnect attempts after the script: refuse
+            return
+        first_conn[0] = False
+        try:
+            await exact_read(reader, CONNECT, "CONNECT")
+            writer.write(CONNACK)
+            await exact_read(reader, SUBSCRIBE, "SUBSCRIBE")
+            writer.write(SUBACK)
+            writer.write(WORK_PUBLISH)
+            await writer.drain()
+            await exact_read(reader, WORK_PUBACK, "PUBACK(work)")
+            await exact_read(reader, RESULT_PUBLISH, "PUBLISH(result)")
+            writer.write(RESULT_PUBACK)
+            await writer.drain()
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+            mismatches.append(f"stream ended early: {e!r}")
+        finally:
+            done.set()
+
+    async def main():
+        nonlocal_done = asyncio.Event()
+        nonlocal done
+        done = nonlocal_done
+        server = await asyncio.start_server(fake_mosquitto, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        t = MqttTransport(
+            port=port, username="client", password="pw", client_id="w1",
+            clean_session=True,
+        )
+        await t.connect()
+        await t.subscribe("work/#", QOS_1)
+        msg = await asyncio.wait_for(anext(aiter(t.messages())), 5)
+        assert (msg.topic, msg.payload, msg.qos) == ("work/ondemand", "AB,cafe", 1)
+        await t.publish("result/ondemand", "AB,beef", QOS_1)  # awaits PUBACK
+        await asyncio.wait_for(done.wait(), 5)  # script ran to completion
+        await t.close()
+        server.close()
+        assert not mismatches, "\n".join(mismatches)
+
+    run(main())
