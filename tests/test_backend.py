@@ -554,3 +554,65 @@ def test_pipeline_solve_correct_under_speculation(backend):
         await b.close()
 
     asyncio.run(run())
+
+
+def test_mixed_load_rung_fairness_under_flood():
+    """Adversarial mix (the benchmarks/fairness.py shape, deterministic):
+    a sustained easy flood plus one unreachable-hard job. Round-robin rung
+    service must give BOTH rungs a bounded share of launches — the hard job
+    is never starved by the flood, and the flood never stalls behind the
+    hard job's wide launches."""
+
+    async def run():
+        b = make_backend(run_steps=16, pipeline=2)
+        launches = []
+        orig = b._launch
+
+        def traced(params, steps):
+            launches.append(steps)
+            return orig(params, steps)
+
+        b._launch = traced
+        await b.setup()
+
+        hard = random_hash()
+        t_hard = asyncio.ensure_future(b.generate(WorkRequest(hard, (1 << 64) - 2)))
+        stop = asyncio.Event()
+
+        async def flooder():
+            while not stop.is_set():
+                w = await b.generate(WorkRequest(random_hash(), EASY))
+                assert w
+
+        floods = [asyncio.ensure_future(flooder()) for _ in range(3)]
+        await asyncio.sleep(0)
+        launches.clear()  # measure only the mixed phase
+        while len(launches) < 24:
+            await asyncio.sleep(0.01)
+        window = list(launches[:24])
+        stop.set()
+        for f in floods:
+            f.cancel()
+        await asyncio.gather(*floods, return_exceptions=True)
+        await b.cancel(hard)
+        with pytest.raises(WorkCancelled):
+            await t_hard
+        await b.close()
+
+        hard_n = sum(1 for s in window if s == 16)
+        easy_n = sum(1 for s in window if s == 1)
+        # Round-robin over two live rungs → each gets ~half the launches;
+        # a third is the regression bound (serving one rung only would put
+        # the other at 0).
+        assert hard_n >= len(window) // 3, window
+        assert easy_n >= len(window) // 3, window
+        # And no rung monopolizes: never 4+ consecutive same-rung launches
+        # while both are pending.
+        run_len, worst, prev = 0, 0, None
+        for s in window:
+            run_len = run_len + 1 if s == prev else 1
+            worst = max(worst, run_len)
+            prev = s
+        assert worst <= 3, window
+
+    asyncio.run(run())
