@@ -1,0 +1,161 @@
+"""NanoWebsocketClient: the precache feed from the nano node.
+
+Runs a REAL local websockets server playing the node role (parity surface:
+reference server/dpow/nano_websocket.py — subscribe/ack handshake,
+confirmation forwarding, reconnect-on-drop)."""
+
+import asyncio
+import json
+
+import pytest
+import websockets
+
+from tpu_dpow.server.nano_ws import NanoWebsocketClient
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+class FakeNode:
+    """Minimal nano-node websocket: acks subscribes, replays a script."""
+
+    def __init__(self):
+        self.server = None
+        self.conns = 0
+        self.script = []  # raw frames pushed to each new subscriber
+        self._clients = set()
+
+    async def start(self):
+        self.server = await websockets.serve(self._handle, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    async def _handle(self, ws):
+        self.conns += 1
+        self._clients.add(ws)
+        try:
+            sub = json.loads(await ws.recv())
+            assert sub["action"] == "subscribe" and sub["topic"] == "confirmation"
+            await ws.send(json.dumps({"ack": "subscribe"}))
+            for frame in self.script:
+                await ws.send(frame)
+            async for _ in ws:
+                pass  # hold the connection open
+        except websockets.ConnectionClosed:
+            pass
+        finally:
+            self._clients.discard(ws)
+
+    async def push(self, frame: str):
+        for ws in list(self._clients):
+            await ws.send(frame)
+
+    async def kick_all(self):
+        for ws in list(self._clients):
+            await ws.close()
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def confirmation(block_hash: str) -> str:
+    return json.dumps(
+        {"topic": "confirmation",
+         "message": {"hash": block_hash, "block": {"previous": "00" * 32}}}
+    )
+
+
+def test_subscribe_forward_and_frame_resilience():
+    async def main():
+        node = FakeNode()
+        port = await node.start()
+        got = []
+
+        async def cb(message):
+            got.append(message["hash"])
+            if message["hash"] == "BAD":
+                raise RuntimeError("handler bug")
+
+        client = NanoWebsocketClient(f"ws://127.0.0.1:{port}", cb)
+        client.start()
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if node.conns:
+                break
+        await asyncio.sleep(0.05)
+        # good frame → forwarded
+        await node.push(confirmation("AA" * 32))
+        # garbage + off-topic frames → skipped, socket stays up
+        await node.push("not json{")
+        await node.push(json.dumps({"topic": "vote", "message": {}}))
+        # a FAILING handler must not tear the feed down either
+        await node.push(confirmation("BAD"))
+        await node.push(confirmation("BB" * 32))
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if "BB" * 32 in got:
+                break
+        assert got == ["AA" * 32, "BAD", "BB" * 32]
+        assert node.conns == 1  # nothing above caused a reconnect
+        await client.stop()
+        await node.stop()
+
+    run(main())
+
+
+def test_reconnects_after_drop_with_backoff():
+    async def main():
+        node = FakeNode()
+        port = await node.start()
+        got = []
+
+        async def cb(message):
+            got.append(message["hash"])
+
+        client = NanoWebsocketClient(
+            f"ws://127.0.0.1:{port}", cb, reconnect_interval=0.2
+        )
+        client.start()
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if node.conns == 1:
+                break
+        await node.kick_all()  # node restarts
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if node.conns >= 2:
+                break
+        assert node.conns >= 2, "client never reconnected"
+        await asyncio.sleep(0.05)
+        await node.push(confirmation("CC" * 32))
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if got:
+                break
+        assert got == ["CC" * 32]  # resubscribed and kept forwarding
+        await client.stop()
+        await node.stop()
+
+    run(main())
+
+
+def test_stop_is_clean_mid_connection():
+    async def main():
+        node = FakeNode()
+        port = await node.start()
+
+        async def cb(message):
+            pass
+
+        client = NanoWebsocketClient(f"ws://127.0.0.1:{port}", cb)
+        client.start()
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if node.conns:
+                break
+        await client.stop()  # must not raise nor leak the task
+        assert client._task is None
+        await node.stop()
+
+    run(main())
