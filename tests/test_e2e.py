@@ -426,3 +426,92 @@ def test_e2e_precache_flood_and_frontier_churn():
             await stop_stack(runner, clients)
 
     run(main())
+
+
+def test_e2e_mqtt_worker_drop_gets_cancel_on_reconnect():
+    """QoS-1 redelivery through the REAL client stack: a worker whose MQTT
+    connection dies right when the server fans out a cancel must receive
+    that cancel on reconnect (durable session + un-PUBACKed salvage) and
+    stop grinding the hash. The reference depends on Mosquitto for exactly
+    this (reference client/dpow_client.py:143-147)."""
+    from tpu_dpow.transport.mqtt import MqttTransport
+
+    async def main():
+        broker = Broker(users=default_users())
+        tcp_server = TcpBrokerServer(broker, port=0)
+        await tcp_server.start()
+        port = tcp_server.port
+
+        config = ServerConfig(
+            base_difficulty=EASY_BASE, throttle=1000.0,
+            heartbeat_interval=0.05, statistics_interval=3600.0,
+            service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store,
+            MqttTransport(port=port, username="dpowserver", password="dpowserver",
+                          client_id="server"),
+        )
+        runner = ServerRunner(server, config)
+        await runner.start()
+
+        client = make_client(
+            MqttTransport(port=port, username="client", password="client",
+                          client_id="w-drop", clean_session=False),
+            PAYOUT_1,
+        )
+        await client.setup()
+        client.start_loops()
+        try:
+            # Hand the worker a hash it can never solve, directly over the
+            # work topic (no service request: nothing resolves early).
+            hard = random_hash()
+            await server.transport.publish(
+                "work/ondemand", f"{hard},{(1 << 64) - 1:016x}", qos=0
+            )
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if hard in client.work_handler.ongoing:
+                    break
+            assert hard in client.work_handler.ongoing
+
+            # Cut the worker's actual socket with reconnection held off for
+            # a few attempts (a real network outage, not a blip): the broker
+            # detaches the durable session and the QoS-1 cancel published
+            # during the outage lands in its offline queue.
+            real_open = client.transport._open
+            outage = {"n": 4}
+
+            async def failing_open():
+                if outage["n"] > 0:
+                    outage["n"] -= 1
+                    raise ConnectionError("network down (test)")
+                await real_open()
+
+            client.transport._open = failing_open
+            client.transport._writer.close()
+            session = broker.sessions["w-drop"]
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if session.queue is None:
+                    break
+            assert session.queue is None, "broker never noticed the cut"
+            await server.transport.publish("cancel/ondemand", hard, qos=1)
+            assert [m.payload for m in session.offline] == [hard]
+
+            # The client's rx loop reconnects on its own (same durable
+            # client_id); the queued cancel must arrive and stop the work.
+            for _ in range(300):
+                await asyncio.sleep(0.02)
+                if hard not in client.work_handler.ongoing:
+                    break
+            assert hard not in client.work_handler.ongoing, (
+                "queued QoS-1 cancel never reached the reconnected worker"
+            )
+        finally:
+            await client.close()
+            await runner.stop()
+            await tcp_server.stop()
+
+    run(main())
