@@ -1,0 +1,96 @@
+"""One-shot TPU evidence capture, priority-ordered for a flaky tunnel.
+
+Round-2 postmortem: the tunnel can die for hours mid-session, so when it IS
+up, evidence must land immediately — headline first, diagnostics last.
+This runs every measurement in priority order, each in a bounded child
+process, and appends results to BENCH_latency.json after EACH step, so a
+tunnel that dies halfway still leaves the top-priority numbers on disk.
+
+Order:
+  1. bench.py            — the headline H/s artifact (the driver's metric)
+  2. tests_tpu           — on-chip correctness suite
+  3. latency (base, 8x)  — p50/p95 through the full backend
+  4. flood               — e2e req/s through the HTTP->broker->engine stack
+  5. fairness            — mixed-load scheduling tax
+  6. overhead            — engine overhead decomposition
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/capture_evidence.py
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_latency.json")
+
+STEPS = [
+    ("headline", [sys.executable, "bench.py"], 900),
+    ("tests_tpu", [sys.executable, "-m", "pytest", "tests_tpu", "-q",
+                   "--no-header", "-p", "no:cacheprovider"], 1200),
+    ("latency_base", [sys.executable, "benchmarks/latency.py", "--n", "20"], 600),
+    ("latency_8x", [sys.executable, "benchmarks/latency.py", "--n", "10",
+                    "--multiplier", "8"], 900),
+    ("flood", [sys.executable, "benchmarks/flood.py", "--n", "100",
+               "--concurrency", "20"], 900),
+    ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
+    ("overhead", [sys.executable, "benchmarks/overhead.py"], 900),
+]
+
+
+def load() -> dict:
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def save(data: dict) -> None:
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2)
+    os.replace(tmp, OUT)
+
+
+def main() -> int:
+    results = load()
+    results["capture_started_unix"] = round(time.time(), 1)
+    for name, cmd, timeout in STEPS:
+        print(f"== {name}: {' '.join(cmd)}", flush=True)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout
+            )
+            tail = (proc.stdout or "").strip().splitlines()
+            record = {"rc": proc.returncode, "seconds": round(time.time() - t0, 1)}
+            # keep the last JSON line if any step prints one
+            for line in reversed(tail):
+                try:
+                    record["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            if "result" not in record and tail:
+                record["tail"] = tail[-3:]
+            if proc.returncode != 0:
+                record["stderr_tail"] = (proc.stderr or "").strip().splitlines()[-3:]
+        except subprocess.TimeoutExpired:
+            record = {"rc": "timeout", "seconds": round(time.time() - t0, 1)}
+        results[name] = record
+        save(results)  # progressive: a dead tunnel still leaves earlier steps
+        print(f"   -> {json.dumps(record)[:240]}", flush=True)
+    results["capture_finished_unix"] = round(time.time(), 1)
+    save(results)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
