@@ -191,11 +191,14 @@ def main() -> int:
     signal.signal(signal.SIGTERM, _terminated)
     signal.signal(signal.SIGINT, _terminated)
 
-    # The CPU fallback runs CONCURRENTLY from the start (it is cheap — a
-    # pinned-platform child that finishes in well under a minute) so that a
-    # full tunnel outage plus a driver timeout landing anywhere inside the
-    # ~11-minute TPU retry window still SIGTERM-exits with a valid labeled
-    # CPU number in _best_result instead of a value-0 artifact.
+    # The CPU fallback runs concurrently with the LATER TPU retries (not
+    # attempt 1: its all-core measurement would contend with the TPU
+    # child's host-side cold compile — or, worse, with a TPU attempt that
+    # silently resolved to CPU — and skew whichever number gets recorded).
+    # Starting it after the first failure still bounds the all-hang path:
+    # a valid labeled CPU number sits in _best_result by ~attempt-1-timeout
+    # + 60 s, so a driver SIGTERM anywhere in the remaining ~7-minute retry
+    # window exits with a real measurement instead of value 0.
     cpu_box: dict = {}
 
     def _cpu_fallback():
@@ -208,7 +211,6 @@ def main() -> int:
             _best_result = res
 
     cpu_thread = threading.Thread(target=_cpu_fallback, daemon=True)
-    cpu_thread.start()
 
     result = None
     attempts = []
@@ -226,6 +228,8 @@ def main() -> int:
             result = None
         else:
             attempts.append(f"attempt {i + 1}: {why}")
+        if not cpu_thread.is_alive() and "result" not in cpu_box:
+            cpu_thread.start()
     if result is None:
         # All TPU attempts failed/hung: fall back to the concurrent CPU
         # measurement (already done or nearly so by now).

@@ -650,3 +650,62 @@ def test_mqtt_transport_stock_broker_golden_interop():
         assert not mismatches, "\n".join(mismatches)
 
     run(main())
+
+
+def test_mqtt_qos1_inflight_window_flow_control():
+    """A client that never PUBACKs (but keeps the connection alive) must not
+    grow the broker's un-acked tracking past MAX_INFLIGHT_QOS1 — delivery
+    pauses until acks arrive, then resumes, and every message eventually
+    lands exactly-once-or-more (never silently lost to a mid collision)."""
+    from tpu_dpow.transport import mqtt as mqtt_mod
+
+    async def raw_connect(port, client_id):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(mc.encode(mc.Connect(
+            client_id=client_id, clean_session=False, keepalive=60
+        )))
+        await writer.drain()
+        assert isinstance(await mc.read_packet(reader), mc.Connack)
+        return reader, writer
+
+    async def main():
+        srv = await _start_broker()
+        old_cap = mqtt_mod.MAX_INFLIGHT_QOS1
+        mqtt_mod.MAX_INFLIGHT_QOS1 = 4  # small window for the test
+        try:
+            reader, writer = await raw_connect(srv.port, "slowacker")
+            writer.write(mc.encode(mc.Subscribe(mid=1, topics=[("cancel/#", 1)])))
+            await writer.drain()
+            assert isinstance(await mc.read_packet(reader), mc.Suback)
+
+            pub = MqttTransport(port=srv.port, client_id="pub-fc")
+            await pub.connect()
+            for i in range(10):
+                await pub.publish("cancel/ondemand", f"M{i}", QOS_1)
+            # Without acks only the window's worth arrives.
+            got = []
+            for _ in range(4):
+                pkt = await asyncio.wait_for(mc.read_packet(reader), 5)
+                assert isinstance(pkt, mc.Publish)
+                got.append(pkt)
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(mc.read_packet(reader), 0.3)
+            # Ack the window: delivery resumes for the rest.
+            for pkt in got:
+                writer.write(mc.encode(mc.Puback(mid=pkt.mid)))
+            await writer.drain()
+            payloads = [p.payload.decode() for p in got]
+            while len(payloads) < 10:
+                pkt = await asyncio.wait_for(mc.read_packet(reader), 5)
+                assert isinstance(pkt, mc.Publish)
+                payloads.append(pkt.payload.decode())
+                writer.write(mc.encode(mc.Puback(mid=pkt.mid)))
+                await writer.drain()
+            assert payloads == [f"M{i}" for i in range(10)]
+            writer.close()
+            await pub.close()
+        finally:
+            mqtt_mod.MAX_INFLIGHT_QOS1 = old_cap
+            await srv.stop()
+
+    run(main())

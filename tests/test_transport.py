@@ -593,3 +593,29 @@ def test_subscribe_verdict_surfaces_over_the_wire():
             await srv.stop()
 
     run(main())
+
+
+def test_durable_takeover_salvages_queue_and_keeps_poison_pill():
+    """Regression: the takeover salvage must not eat the poison pill meant
+    for the old connection's pump — after a durable-session takeover the
+    old queue holds exactly the pill (so the stale pump exits), and the
+    undelivered QoS-1 messages reappear in the NEW queue (dup-marked)."""
+    import asyncio as aio
+
+    async def main():
+        broker = Broker()
+        s1 = broker.attach("w", "", "", clean_session=False)
+        broker.subscribe(s1, "cancel/#", 1)
+        old_queue = s1.queue
+        broker.publish(None, "cancel/ondemand", "H1", 1)
+        s2 = broker.attach("w", "", "", clean_session=False)  # takeover
+        assert s2 is s1
+        # the old pump's queue: just the pill
+        assert old_queue.get_nowait() is None
+        with pytest.raises(aio.QueueEmpty):
+            old_queue.get_nowait()
+        # the undelivered QoS-1 message moved to the new connection
+        replayed = s2.queue.get_nowait()
+        assert (replayed.payload, replayed.dup) == ("H1", True)
+
+    run(main())

@@ -99,6 +99,13 @@ class Broker:
             # into the NEW queue just below), not back into the dying one.
             old_queue, session.queue = session.queue, None
             self._salvage(session, old_queue)
+            # The drain above also consumed the takeover poison pill put
+            # a few statements earlier (attach is synchronous throughout,
+            # so the old pump cannot have seen it yet) — re-arm it, or the
+            # old connection's pump re-parks on the orphaned queue and the
+            # stale connection outlives the takeover (forever at keepalive
+            # 0, the NAT-drop case the pill exists for).
+            old_queue.put_nowait(None)
         session.queue = asyncio.Queue(maxsize=MAX_QUEUE)
         # Replay QoS-1 messages queued while this session was offline (or
         # salvaged from a taken-over/detached connection), oldest first.

@@ -38,6 +38,11 @@ logger = logging.getLogger(__name__)
 
 _ids = itertools.count()
 
+# Outbound QoS-1 in-flight window per connection (Mosquitto max_inflight
+# analog). Far below the 65000-mid wrap, so a reused mid can never collide
+# with one still awaiting its PUBACK.
+MAX_INFLIGHT_QOS1 = 256
+
 
 async def handle_mqtt_conn(
     broker: Broker,
@@ -59,8 +64,14 @@ async def handle_mqtt_conn(
     # (insertion-ordered). Whatever is still here when the connection dies
     # is requeued for redelivery — the per-packet at-least-once leg that
     # the reference's client depends on from Mosquitto for cancels
-    # (reference client/dpow_client.py:143-147).
+    # (reference client/dpow_client.py:143-147). The in-flight window is
+    # capped (Mosquitto's max_inflight): a client that answers pings but
+    # never PUBACKs would otherwise grow this without bound, and after the
+    # 16-bit mid counter wraps a reused mid would silently evict a
+    # still-outstanding message from redelivery tracking.
     unacked: dict = {}
+    ack_space = asyncio.Event()
+    ack_space.set()
 
     def send(pkt) -> None:
         writer.write(mc.encode(pkt))
@@ -76,6 +87,12 @@ async def handle_mqtt_conn(
                     break
                 mid = None
                 if msg.qos > 0:
+                    while len(unacked) >= MAX_INFLIGHT_QOS1:
+                        # Flow control: hold QoS-1 delivery until acks
+                        # drain the window (new messages keep queuing in
+                        # the bounded session queue meanwhile).
+                        ack_space.clear()
+                        await ack_space.wait()
                     mid = next(out_mid) % 65000 + 1  # u16, nonzero: wrap
                     # Record BEFORE the write: a drop inside drain() must
                     # still count this message as outstanding.
@@ -130,6 +147,7 @@ async def handle_mqtt_conn(
                 send(mc.Pingresp())
             elif isinstance(pkt, mc.Puback):
                 unacked.pop(pkt.mid, None)
+                ack_space.set()  # wake a flow-control-parked pump
             elif isinstance(pkt, mc.Publish):
                 payload = pkt.payload.decode("utf-8", errors="replace")
                 try:
