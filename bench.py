@@ -191,15 +191,17 @@ def main() -> int:
     signal.signal(signal.SIGTERM, _terminated)
     signal.signal(signal.SIGINT, _terminated)
 
-    # The CPU fallback runs concurrently with the LATER TPU retries (not
-    # attempt 1: its all-core measurement would contend with the TPU
-    # child's host-side cold compile — or, worse, with a TPU attempt that
-    # silently resolved to CPU — and skew whichever number gets recorded).
-    # Starting it after the first failure still bounds the all-hang path:
-    # a valid labeled CPU number sits in _best_result by ~attempt-1-timeout
-    # + 60 s, so a driver SIGTERM anywhere in the remaining ~7-minute retry
-    # window exits with a real measurement instead of value 0.
+    # The CPU fallback must not run during the TPU child's early window
+    # (its all-core measurement would contend with the host-side cold
+    # compile — or double-measure against a TPU attempt that silently
+    # resolved to CPU — skewing whichever number gets recorded), but it
+    # also cannot wait for attempt 1's full 240 s timeout: a driver whose
+    # own budget is short would SIGTERM us with _best_result still empty
+    # and the round would record value 0. Compromise: start it at the
+    # EARLIER of first-attempt failure or t=90 s (cold compile is 20-40 s,
+    # so a healthy chip has long finished measuring by then).
     cpu_box: dict = {}
+    cpu_started = threading.Lock()
 
     def _cpu_fallback():
         global _best_result
@@ -211,6 +213,18 @@ def main() -> int:
             _best_result = res
 
     cpu_thread = threading.Thread(target=_cpu_fallback, daemon=True)
+
+    def _start_cpu_fallback():
+        with cpu_started:
+            if not cpu_thread.is_alive() and "result" not in cpu_box:
+                try:
+                    cpu_thread.start()
+                except RuntimeError:
+                    pass  # already started (timer/loop race)
+
+    cpu_timer = threading.Timer(90, lambda: _best_result is None and _start_cpu_fallback())
+    cpu_timer.daemon = True
+    cpu_timer.start()
 
     result = None
     attempts = []
@@ -228,8 +242,8 @@ def main() -> int:
             result = None
         else:
             attempts.append(f"attempt {i + 1}: {why}")
-        if not cpu_thread.is_alive() and "result" not in cpu_box:
-            cpu_thread.start()
+        _start_cpu_fallback()
+    cpu_timer.cancel()
     if result is None:
         # All TPU attempts failed/hung: fall back to the concurrent CPU
         # measurement (already done or nearly so by now).
