@@ -29,13 +29,14 @@ from tpu_dpow.utils import nanocrypto as nc
 RNG = np.random.default_rng(0xD0)
 
 
-async def run(n: int, difficulty: int, backend_name: str) -> None:
+async def run(n: int, difficulty: int, backend_name: str, step_ladder: str = "x4") -> None:
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if backend_name == "jax" and not on_tpu:
         difficulty = min(difficulty, 0xFFF0000000000000)  # keep CPU runs sane
-    backend = get_backend(backend_name)
+    kwargs = {"step_ladder": step_ladder} if backend_name == "jax" else {}
+    backend = get_backend(backend_name, **kwargs)
     await backend.setup()
     times = []
     for _ in range(n):
@@ -65,6 +66,8 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=20)
     p.add_argument("--multiplier", type=float, default=1.0)
+    p.add_argument("--step_ladder", default="x4", choices=["x4", "x2"],
+                   help="run-length quantization ladder A/B (backend=jax)")
     p.add_argument("--difficulty", default=None, help="hex override")
     p.add_argument("--backend", default="jax", choices=["jax", "native"])
     args = p.parse_args()
@@ -72,4 +75,4 @@ if __name__ == "__main__":
         diff = int(args.difficulty, 16)
     else:
         diff = nc.derive_work_difficulty(args.multiplier)
-    asyncio.run(run(args.n, diff, args.backend))
+    asyncio.run(run(args.n, diff, args.backend, args.step_ladder))

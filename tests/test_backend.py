@@ -616,3 +616,36 @@ def test_mixed_load_rung_fairness_under_flood():
         assert worst <= 3, window
 
     asyncio.run(run())
+
+
+def test_step_ladder_options():
+    """x2 ladder halves the run-length quantum; x4 stays the default."""
+    b4 = make_backend(run_steps=16)
+    assert b4._step_counts() == [1, 4, 16]
+    b2 = make_backend(run_steps=16, step_ladder="x2")
+    assert b2._step_counts() == [1, 2, 4, 8, 16]
+    # _steps_for picks the finer rung when available: a difficulty whose
+    # 2x-median lands between 1 and 4 windows gets 2 on the x2 ladder.
+    target = None
+    for exp in range(10, 30):
+        d = (1 << 64) - (1 << exp)
+        if 1 < 2 * 0.693 * (2**64 - d) ** -1 * 2**64 / b2.chunk <= 2:
+            target = d
+            break
+    if target is not None:
+        assert b2._steps_for(target) == 2
+        assert b4._steps_for(target) == 4
+    with pytest.raises(WorkError):
+        make_backend(step_ladder="bogus")
+
+
+def test_step_ladder_x2_generates_valid_work():
+    async def run():
+        b = make_backend(run_steps=4, step_ladder="x2")
+        await b.setup()
+        h = random_hash()
+        work = await b.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        await b.close()
+
+    asyncio.run(run())

@@ -125,6 +125,7 @@ class JaxWorkBackend(WorkBackend):
         warm_shapes: Optional[bool] = None,  # background-compile launch shapes
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
         pipeline: int = 2,  # launches in flight at once (1 = no overlap)
+        step_ladder: str = "x4",  # run-length quantization: 'x4' | 'x2'
     ):
         if mesh_devices > 1:
             # local_devices: under a jax.distributed multi-host slice the
@@ -209,6 +210,9 @@ class JaxWorkBackend(WorkBackend):
         # cancel-in-flight race. Worst-case cancel latency grows to
         # pipeline * run_steps windows.
         self.pipeline = max(1, pipeline)
+        if step_ladder not in ("x4", "x2"):
+            raise WorkError(f"step_ladder must be 'x4' or 'x2', not {step_ladder!r}")
+        self.step_ladder = step_ladder
         self._warm: set = set()
         self._warm_task: Optional[asyncio.Task] = None
         # Dedicated launch executor (2 workers: one engine launch + one warm
@@ -405,13 +409,18 @@ class JaxWorkBackend(WorkBackend):
         """The quantized run lengths the engine may emit (ascending).
 
         Each distinct count is a separate compile of the run loop, so the
-        ladder is powers of four — few enough to warm at setup, granular
-        enough that easy difficulties return to the host (and thus to
-        fresh arrivals and cancels) after one or two windows.
+        default ladder is powers of four — few enough to warm at setup,
+        granular enough that easy difficulties return to the host (and thus
+        to fresh arrivals and cancels) after one or two windows. The
+        ``step_ladder="x2"`` option halves the quantization step (base
+        difficulty then launches 2 windows instead of 4 — less span to
+        drain past the hit) at the cost of ~2x the warm compiles; which
+        wins is an on-chip measurement (benchmarks/latency.py A/B).
         """
+        factor = 2 if self.step_ladder == "x2" else 4
         counts, steps = [1], 1
         while steps < self.run_steps:
-            steps = min(steps * 4, self.run_steps)
+            steps = min(steps * factor, self.run_steps)
             counts.append(steps)
         return counts
 

@@ -56,6 +56,7 @@ class DpowClient:
                     kwargs["run_steps"] = config.run_steps
                 if config.pipeline > 0:
                     kwargs["pipeline"] = config.pipeline
+                kwargs["step_ladder"] = config.step_ladder
             backend = get_backend(config.backend, **kwargs)
         # The handler's in-flight cap must exceed the engine's batch size or
         # the batched launch can never fill (the queue would starve it at 8

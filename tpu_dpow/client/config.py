@@ -24,6 +24,7 @@ class ClientConfig:
     mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
     pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
+    step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
     work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
     client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
     log_file: Optional[str] = None
@@ -67,6 +68,10 @@ def parse_args(argv=None) -> ClientConfig:
                    help="device launches in flight at once (backend=jax; "
                    "0 = auto: 2 — overlaps readback of one launch with "
                    "device execution of the next; 1 disables the overlap)")
+    p.add_argument("--step_ladder", default=c.step_ladder, choices=["x4", "x2"],
+                   help="run-length quantization ladder (backend=jax): x2 halves "
+                   "the window quantum for easy difficulties at ~2x the warmup "
+                   "compiles")
     p.add_argument("--work_concurrency", type=int, default=c.work_concurrency,
                    help="work items in flight at once (0 = auto: 2*max_batch "
                    "for the jax backend, 8 otherwise)")
