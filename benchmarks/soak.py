@@ -1,0 +1,109 @@
+"""Extended chaos soak through the full stack.
+
+The CI-sized version lives in tests/test_e2e.py (soak test); this is the
+operator-scale run: N waves of mixed traffic — normal requests, raised
+difficulties, client aborts mid-request — against two workers on the
+pipelined engine, then a drain check that nothing leaked (no ongoing
+handler work, no live backend jobs). The reference can only soak against a
+live swarm (SURVEY.md §4); here the whole swarm is in-process.
+
+Usage: python benchmarks/soak.py [--waves 15] [--width 20]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+import aiohttp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(_bootstrap.__file__), "..", "tests"))
+
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0x50AC)
+
+
+async def run(waves: int, width: int) -> None:
+    import jax
+
+    from test_e2e import EASY_BASE, start_stack, stop_stack
+    from tpu_dpow.transport.broker import Broker
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    broker = Broker()
+    runner, server, store, clients = await start_stack(broker, n_clients=2)
+    url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+    results = {"ok": 0, "aborted": 0, "error": 0}
+
+    async def one_op(http, i):
+        h = RNG.bytes(32).hex().upper()
+        kind = i % 5
+        try:
+            if kind == 4:  # client aborts mid-request
+                try:
+                    async with http.post(
+                        url, json={"user": "svc", "api_key": "secret", "hash": h},
+                        timeout=aiohttp.ClientTimeout(total=0.01),
+                    ) as r:
+                        await r.json()
+                except Exception:
+                    results["aborted"] += 1
+                return
+            payload = {"user": "svc", "api_key": "secret", "hash": h}
+            if kind == 3:
+                payload["difficulty"] = (
+                    f"{nc.derive_work_difficulty(1.5, EASY_BASE):016x}"
+                )
+            async with http.post(url, json=payload) as resp:
+                body = await resp.json()
+            results["ok" if "work" in body else "error"] += 1
+        except Exception:
+            results["error"] += 1
+
+    t0 = time.perf_counter()
+    async with aiohttp.ClientSession() as http:
+        for _ in range(waves):
+            await asyncio.gather(*(one_op(http, i) for i in range(width)))
+    wall = time.perf_counter() - t0
+    await asyncio.sleep(1.0)
+
+    leaks = 0
+    for c in clients:
+        leaks += len(c.work_handler.ongoing)
+        backend = c.work_handler.backend
+        if getattr(backend, "_jobs", None):
+            leaks += sum(
+                1 for j in backend._jobs.values() if not j.future.done()
+            )
+    await stop_stack(runner, clients)
+    print(json.dumps({
+        "bench": "chaos_soak",
+        "platform": "tpu" if on_tpu else "cpu",
+        "ops": waves * width,
+        **results,
+        "leaks": leaks,
+        "wall_s": round(wall, 2),
+        "ok_per_sec": round(results["ok"] / wall, 2),
+    }))
+    if results["error"] or leaks:
+        raise SystemExit(1)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("full-stack chaos soak")
+    p.add_argument("--waves", type=int, default=15)
+    p.add_argument("--width", type=int, default=20)
+    args = p.parse_args()
+    asyncio.run(run(args.waves, args.width))
+
+
+if __name__ == "__main__":
+    main()
