@@ -241,13 +241,22 @@ class JaxWorkBackend(WorkBackend):
                 f"backend self-test failed (nonce {int(hi[0]):08x}{int(lo[0]):08x})"
             )
         self._warm.add((1, 1))
-        if self.run_steps > 1:
-            # Warm the run-mode compiles too (one per quantized step count
-            # the engine can emit, so no request pays a compile wall).
+        if self.run_steps > 1 and not self.warm_shapes:
+            # Warming off (CPU: compiles are cheap): pay the run-mode
+            # ladder compiles inline so behavior is fully deterministic.
             for steps in self._step_counts()[1:]:
                 await self._timed_launch(np.stack([probe]), steps)
                 self._warm.add((1, steps))
-        if self.warm_shapes and self.max_batch > 1 and self._warm_task is None:
+        if self.warm_shapes and self._warm_task is None and (
+            self.max_batch > 1 or self.run_steps > 1
+        ):
+            # With warming ON (TPU), setup() returns after the single
+            # self-test compile; the rest of the shape ladder — including
+            # the (1, steps) run-mode rungs — compiles in the background.
+            # Through a remote tunnel those are ~30 s EACH, and a client
+            # blocked in setup() serves nothing; a request arriving before
+            # its rung is warm just runs at the largest warmed step count
+            # (more round trips, still correct — see _pick_shape).
             self._warm_task = asyncio.ensure_future(self._warmup_loop())
 
     async def generate(self, request: WorkRequest) -> str:
@@ -363,14 +372,24 @@ class JaxWorkBackend(WorkBackend):
         """
         probe = search.pack_params(bytes(32), 1, base=0)
         try:
-            for b in self._batch_sizes()[1:]:
-                for steps in self._step_counts():
-                    if self._closed:
-                        return
-                    if (b, steps) in self._warm:
-                        continue
-                    await self._timed_launch(np.stack([probe] * b), steps)
-                    self._warm.add((b, steps))
+            # Priority order: the flood shape (max_batch, 1) first — batched
+            # base-difficulty traffic is the dominant cold-start load — then
+            # the singleton run-mode rungs (solo-request latency), then the
+            # batched run-mode rungs.
+            shapes = [(b, 1) for b in self._batch_sizes()[1:]]
+            shapes += [(1, s) for s in self._step_counts()[1:]]
+            shapes += [
+                (b, s)
+                for b in self._batch_sizes()[1:]
+                for s in self._step_counts()[1:]
+            ]
+            for b, steps in shapes:
+                if self._closed:
+                    return
+                if (b, steps) in self._warm:
+                    continue
+                await self._timed_launch(np.stack([probe] * b), steps)
+                self._warm.add((b, steps))
         except asyncio.CancelledError:
             raise
         except Exception:
