@@ -41,6 +41,9 @@ STEPS = [
                "--concurrency", "20"], 900),
     ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
     ("overhead", [sys.executable, "benchmarks/overhead.py"], 900),
+    ("batch", [sys.executable, "benchmarks/batch.py"], 600),
+    ("soak", [sys.executable, "benchmarks/soak.py", "--waves", "10",
+              "--width", "16"], 600),
 ]
 
 
