@@ -649,3 +649,44 @@ def test_step_ladder_x2_generates_valid_work():
         await b.close()
 
     asyncio.run(run())
+
+
+def test_pipelined_launch_timeout_fails_clean_and_recovers():
+    """With two launches in flight, the OLDEST timing out must fail every
+    waiter with WorkError, abandon both wedged threads with the executor,
+    and leave the engine restartable — a straggler thread completing later
+    must not corrupt the fresh engine's state."""
+    import threading
+    import time as _time
+
+    async def run():
+        b = make_backend(launch_timeout=0.3, pipeline=2)
+        await b.setup()
+        real_launch = b._launch
+        gate = threading.Event()  # stragglers park here, released at the end
+        wedge = {"on": True}
+
+        def wedged(params, steps):
+            if wedge["on"]:
+                gate.wait(timeout=10)
+            return real_launch(params, steps)
+
+        b._launch = wedged
+        # Unreachable-hard job keeps BOTH pipeline slots occupied.
+        with pytest.raises(WorkError):
+            await b.generate(WorkRequest(random_hash(), (1 << 64) - 1))
+        wedge["on"] = False
+        # Fresh engine on a fresh executor solves immediately...
+        h = random_hash()
+        work = await b.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        # ...and releasing the two abandoned straggler threads afterwards
+        # must not disturb anything (their results go to dropped futures).
+        gate.set()
+        await asyncio.sleep(0.1)
+        h2 = random_hash()
+        work2 = await b.generate(WorkRequest(h2, EASY))
+        nc.validate_work(h2, work2, EASY)
+        await b.close()
+
+    asyncio.run(run())
