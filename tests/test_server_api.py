@@ -151,3 +151,41 @@ def test_unix_socket_service_face(tmp_path):
             nc.validate_work(h, body["work"], EASY_BASE)
 
     run(main())
+
+
+def test_upcheck_broker_observability():
+    """/upcheck/broker exposes the embedded broker's routing counters and
+    session inventory; 404 when the broker is external."""
+
+    async def main():
+        async with ApiHarness() as hx:
+            # default harness: no broker handed to the runner -> 404
+            async with hx.http.get(hx.url("upcheck", "/upcheck/broker")) as r:
+                assert r.status == 404
+
+        hx = ApiHarness()
+        hx.runner = ServerRunner(hx.server, hx.config, broker=hx.broker)
+        await hx.runner.start()
+        hx.http = aiohttp.ClientSession()
+        try:
+            await hx.register_service("svc", "secret")
+            await hx.start_worker()
+            h = random_hash()
+            await hx.server.service_handler(hx.request(h, account=ACCOUNT))
+            async with hx.http.get(hx.url("upcheck", "/upcheck/broker")) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["stats"]["published"] >= 1
+            assert body["stats"]["delivered"] >= 1
+            worker_sessions = [
+                s for cid, s in body["sessions"].items() if cid.startswith("worker")
+            ]
+            assert worker_sessions and worker_sessions[0]["connected"]
+            assert worker_sessions[0]["subscriptions"] >= 1
+        finally:
+            if hx.worker_task:
+                hx.worker_task.cancel()
+            await hx.http.close()
+            await hx.runner.stop()
+
+    run(main())

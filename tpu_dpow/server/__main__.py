@@ -45,7 +45,8 @@ async def amain(argv=None) -> None:
 
     store = get_store(config.store_uri)
     server = DpowServer(config, store, transport)
-    runner = ServerRunner(server, config)
+    runner = ServerRunner(server, config,
+                          broker=broker if config.inproc_broker else None)
     await runner.start()
     logger.info("tpu-dpow server up; service ports %s", runner.ports)
 

@@ -51,7 +51,7 @@ async def _handle_service_request(server: DpowServer, data) -> dict:
     return response
 
 
-def build_apps(server: DpowServer):
+def build_apps(server: DpowServer, broker=None):
     """Returns (service_app, ws_app, upcheck_app, blocks_app)."""
 
     async def service_post_handler(request: web.Request) -> web.Response:
@@ -79,6 +79,23 @@ def build_apps(server: DpowServer):
 
     async def upcheck_handler(request: web.Request) -> web.Response:
         return web.Response(text="up")
+
+    async def upcheck_broker_handler(request: web.Request) -> web.Response:
+        # Observability for the embedded broker (SURVEY.md §5.5): message
+        # routing counters + live session inventory. 404 when the broker is
+        # external (its own tooling owns those numbers then).
+        if broker is None:
+            raise web.HTTPNotFound()
+        sessions = {
+            s.client_id: {
+                "connected": s.queue is not None,
+                "durable": not s.clean,
+                "subscriptions": len(s.subscriptions),
+                "offline_queued": len(s.offline),
+            }
+            for s in broker.sessions.values()
+        }
+        return web.json_response({"stats": broker.stats, "sessions": sessions})
 
     async def upcheck_blocks_handler(request: web.Request) -> web.Response:
         if not server.last_block:
@@ -108,6 +125,8 @@ def build_apps(server: DpowServer):
     upcheck_app.router.add_get("/upcheck", upcheck_handler)
     upcheck_app.router.add_get("/upcheck/blocks/", upcheck_blocks_handler)
     upcheck_app.router.add_get("/upcheck/blocks", upcheck_blocks_handler)
+    upcheck_app.router.add_get("/upcheck/broker/", upcheck_broker_handler)
+    upcheck_app.router.add_get("/upcheck/broker", upcheck_broker_handler)
 
     blocks_app = web.Application()
     blocks_app.router.add_post("/block/", block_cb_handler)
@@ -119,16 +138,18 @@ def build_apps(server: DpowServer):
 class ServerRunner:
     """Owns the aiohttp runners + the orchestrator's background loops."""
 
-    def __init__(self, server: DpowServer, config: Optional[ServerConfig] = None):
+    def __init__(self, server: DpowServer, config: Optional[ServerConfig] = None,
+                 *, broker=None):
         self.server = server
         self.config = config or server.config
+        self.broker = broker  # embedded-broker observability (optional)
         self._runners: list = []
         self.ports: dict = {}
 
     async def start(self) -> None:
         await self.server.setup()
         self.server.start_loops()
-        service_app, ws_app, upcheck_app, blocks_app = build_apps(self.server)
+        service_app, ws_app, upcheck_app, blocks_app = build_apps(self.server, self.broker)
         c = self.config
         specs = [
             ("service", service_app, c.service_port, c.web_path),
