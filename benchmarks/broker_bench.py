@@ -1,0 +1,103 @@
+"""Broker-plane throughput: the host pub/sub fabric under swarm load.
+
+The device plane gets bench.py; this measures the OTHER half of the
+framework — the embedded broker — under a reference-shaped swarm: N
+worker sessions subscribed to work/# (QoS 0) and cancel/# (QoS 1), a
+server session publishing work/cancel pairs as fast as the loop allows,
+over the real JSON-lines TCP wire. Reports fan-out deliveries/sec and
+publish→last-subscriber latency percentiles. (Mosquitto on similar
+hardware fans out on the order of 10^5 msg/s; the embedded broker only
+needs to beat the swarm's actual traffic — a few hundred msg/s at
+reference scale — by a wide margin.)
+
+Usage: python benchmarks/broker_bench.py [--workers 20] [--msgs 500]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+from tpu_dpow.transport import QOS_0, QOS_1
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.tcp import TcpBrokerServer, TcpTransport
+
+
+async def run(workers: int, msgs: int) -> None:
+    srv = TcpBrokerServer(Broker(), port=0)
+    await srv.start()
+
+    subs = []
+    counters = [0] * workers
+    last_seen = [0.0] * workers
+
+    async def consume(idx: int, t: TcpTransport):
+        async for _ in t.messages():
+            counters[idx] += 1
+            last_seen[idx] = time.perf_counter()
+
+    tasks = []
+    for i in range(workers):
+        t = TcpTransport(port=srv.port, client_id=f"bw{i}")
+        await t.connect()
+        await t.subscribe("work/#", QOS_0)
+        await t.subscribe("cancel/#", QOS_1)
+        subs.append(t)
+        tasks.append(asyncio.ensure_future(consume(i, t)))
+
+    pub = TcpTransport(port=srv.port, client_id="bw-server")
+    await pub.connect()
+
+    expected = msgs * 2 * workers
+    lat = []
+    t0 = time.perf_counter()
+    for n in range(msgs):
+        sent = time.perf_counter()
+        await pub.publish("work/ondemand", f"{'AB' * 32},{n:016x}", QOS_0)
+        await pub.publish("cancel/ondemand", "AB" * 32, QOS_1)
+        if n % 50 == 0:
+            # sample: wait for this pair to reach every subscriber
+            target = (n + 1) * 2
+            while any(c < target for c in counters):
+                await asyncio.sleep(0)
+            lat.append(max(last_seen) - sent)
+    while sum(counters) < expected:
+        await asyncio.sleep(0.01)
+    wall = time.perf_counter() - t0
+
+    for t in subs:
+        await t.close()
+    await pub.close()
+    await srv.stop()
+    for task in tasks:
+        task.cancel()
+
+    lat_ms = np.asarray(lat) * 1e3
+    print(json.dumps({
+        "bench": "broker_fanout",
+        "workers": workers,
+        "published": msgs * 2,
+        "delivered": sum(counters),
+        "deliveries_per_sec": round(sum(counters) / wall, 1),
+        "fanout_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "fanout_p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+        "wall_s": round(wall, 2),
+    }))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("broker fan-out benchmark")
+    p.add_argument("--workers", type=int, default=20)
+    p.add_argument("--msgs", type=int, default=500)
+    args = p.parse_args()
+    asyncio.run(run(args.workers, args.msgs))
+
+
+if __name__ == "__main__":
+    main()
