@@ -37,6 +37,8 @@ STEPS = [
     ("latency_base", [sys.executable, "benchmarks/latency.py", "--n", "20"], 600),
     ("latency_8x", [sys.executable, "benchmarks/latency.py", "--n", "10",
                     "--multiplier", "8"], 900),
+    ("latency_base_x2ladder", [sys.executable, "benchmarks/latency.py",
+                               "--n", "20", "--step_ladder", "x2"], 900),
     ("flood", [sys.executable, "benchmarks/flood.py", "--n", "100",
                "--concurrency", "20"], 900),
     ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
