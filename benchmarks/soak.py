@@ -54,7 +54,11 @@ async def run(waves: int, width: int) -> None:
                         timeout=aiohttp.ClientTimeout(total=0.01),
                     ) as r:
                         await r.json()
-                except Exception:
+                    results["ok"] += 1  # solved inside 10 ms: a real success
+                except (asyncio.TimeoutError, aiohttp.ServerTimeoutError):
+                    # Only the INTENDED failure counts as an abort; anything
+                    # else (refused connection, 500, bad JSON) falls through
+                    # to the error counter so a broken stack cannot pass.
                     results["aborted"] += 1
                 return
             payload = {"user": "svc", "api_key": "secret", "hash": h}
