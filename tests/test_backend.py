@@ -556,6 +556,142 @@ def test_pipeline_solve_correct_under_speculation(backend):
     asyncio.run(run())
 
 
+async def _gated_recording_backend(**kw):
+    """Backend whose launches block on a gate until released, recording the
+    job hashes of every dispatched launch — the harness for pinning WHICH
+    jobs each pipelined launch carries while earlier ones are in flight."""
+    import threading
+
+    b = make_backend(**kw)
+    await b.setup()
+    gate = threading.Event()
+    real_launch = b._launch
+
+    def gated(params, steps):
+        gate.wait(timeout=10)
+        return real_launch(params, steps)
+
+    b._launch = gated
+    real_dispatch = b._dispatch_next
+    records = []
+
+    def recording():
+        rec = real_dispatch()
+        if rec is not None:
+            records.append([j.block_hash for j in rec.jobs])
+        return rec
+
+    b._dispatch_next = recording
+    return b, gate, records
+
+
+def test_pipeline_successor_serves_queue_not_rescan():
+    """Round-3 on-chip finding: with more demand than one batch holds, a
+    pipelined successor launch must serve the UNCOVERED queued jobs, not
+    speculatively re-scan the batch already on the device (that overscan
+    measured 1.8x device hashes/solve and halved flood throughput)."""
+
+    async def run():
+        b, gate, records = await _gated_recording_backend(max_batch=2, pipeline=2)
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(4)]
+        tasks = [asyncio.ensure_future(b.generate(r)) for r in reqs]
+        while len(records) < 2:
+            await asyncio.sleep(0.01)
+        gate.set()
+        works = await asyncio.gather(*tasks)
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        await b.close()
+        # EASY jobs are covered (miss 0.135 < threshold) once dispatched, so
+        # the second in-flight launch must hold the OTHER two jobs.
+        assert not set(records[0]) & set(records[1]), records[:2]
+        assert set(records[0]) | set(records[1]) == {r.block_hash for r in reqs}
+
+    asyncio.run(run())
+
+
+def test_pipeline_idle_speculation_kept_for_lone_job():
+    """With no queued demand, the engine still speculates a covered lone
+    job's next span (hides the readback round trip from the unlucky tail)
+    — but stops at the speculation floor instead of piling ever-deeper
+    speculative launches into extra pipeline slots."""
+
+    async def run():
+        # pipeline=3 exposes the floor: a third speculative launch would
+        # put the job at 0.135^3 ≈ 0.002 < SPEC_MISS_FLOOR, so only two
+        # may ever be in flight for one EASY job.
+        b, gate, records = await _gated_recording_backend(max_batch=2, pipeline=3)
+        r = WorkRequest(random_hash(), EASY)
+        task = asyncio.ensure_future(b.generate(r))
+        while len(records) < 2:
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.1)  # time for a (wrong) third dispatch
+        n_before_release = len(records)
+        gate.set()
+        nc.validate_work(r.block_hash, await task, EASY)
+        await b.close()
+        assert records[0] == [r.block_hash]
+        assert records[1] == [r.block_hash], "idle speculation was lost"
+        assert n_before_release == 2, records
+
+    asyncio.run(run())
+
+
+def test_pipeline_speculation_waste_is_bounded():
+    """When one launch swallows the whole queue (batch-wide max_batch), the
+    speculative successor must NOT re-dispatch every covered row — expected
+    wasted rows are capped (SPEC_WASTE_ROWS) so speculation never costs more
+    device time than the readback round trip it hides. Round-3 on-chip
+    batch-64: the uncapped successor halved solves/s."""
+
+    async def run():
+        b, gate, records = await _gated_recording_backend(max_batch=8, pipeline=2)
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(8)]
+        tasks = [asyncio.ensure_future(b.generate(r)) for r in reqs]
+        while len(records) < 2:
+            await asyncio.sleep(0.01)
+        gate.set()
+        works = await asyncio.gather(*tasks)
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        await b.close()
+        assert len(records[0]) == 8, records[0]
+        # EASY solve probability per covered row is 1 - 0.135 ≈ 0.86, so the
+        # 2.0-expected-wasted-rows cap admits exactly 2 speculative rows.
+        assert len(records[1]) == 2, records[1]
+
+    asyncio.run(run())
+
+
+def test_difficulty_raise_resets_coverage():
+    """Raising a covered job's difficulty must make it immediately eligible
+    for dispatch again: the in-flight spans were launched at the old,
+    easier target and are now unlikely to solve it — treating the job as
+    still covered would stall the raised request behind stale launches."""
+
+    async def run():
+        # A lone EASY job with two speculative launches in flight sits at
+        # miss ≈ 0.018 < SPEC_MISS_FLOOR: _dispatch_next refuses it.
+        b, gate, records = await _gated_recording_backend(max_batch=2, pipeline=2)
+        r = WorkRequest(random_hash(), EASY)
+        task = asyncio.ensure_future(b.generate(r))
+        while len(records) < 2:
+            await asyncio.sleep(0.01)
+        assert b._dispatch_next() is None, "below-floor job must not dispatch"
+        # The raise resets coverage: the very next dispatch decision must
+        # pick the job up again (WITHOUT the reset it stays below floor).
+        assert await b.raise_difficulty(r.block_hash, EASY + (1 << 50))
+        rec3 = b._dispatch_next()
+        assert rec3 is not None, "raised job was not re-dispatched"
+        assert [j.block_hash for j in rec3.jobs] == [r.block_hash]
+        gate.set()
+        work = await task
+        nc.validate_work(r.block_hash, work, EASY + (1 << 50))
+        await b.close()
+
+    asyncio.run(run())
+
+
 def test_mixed_load_rung_fairness_under_flood():
     """Adversarial mix (the benchmarks/fairness.py shape, deterministic):
     a sustained easy flood plus one unreachable-hard job. Round-robin rung

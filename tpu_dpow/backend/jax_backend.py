@@ -63,6 +63,26 @@ from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
 
 _MASK64 = (1 << 64) - 1
 
+# Coverage-aware dispatch (see _dispatch_next): a job is worth another span
+# while P(no in-flight span solves it) is at least this. Below it the job is
+# only dispatched speculatively, and only when NO uncovered demand exists —
+# round 3's on-chip batch benchmark measured 1.8x device overscan (123 M
+# hashes/solve vs ~67 M expected) from unconditionally re-dispatching the
+# same covered jobs while queued jobs waited.
+SPEC_MISS_THRESHOLD = 0.5
+# Even idle-device speculation stops once a job is this likely already
+# solved in flight; deeper speculation is almost pure waste.
+SPEC_MISS_FLOOR = 0.02
+# A purely speculative launch (every included job already covered) may carry
+# at most this many EXPECTED-WASTED rows (sum of per-job solve probability):
+# ~2 rows of median scan ≈ one tunnel round trip of device time, so the
+# speculation never costs more device time than the readback bubble it
+# hides. Without the cap, a batch-wide launch whose whole batch is covered
+# re-dispatches every row — round 3's on-chip batch-64 run burned a full
+# 3.8 s speculative launch (64 rows) to hide a 0.12 s readback and queued
+# the survivors' real launch behind it, halving solves/s.
+SPEC_WASTE_ROWS = 2.0
+
 
 @dataclass
 class _Job:
@@ -73,6 +93,8 @@ class _Job:
     base: int
     cancelled: bool = False
     waiters: int = 0  # refcount: last cancelled waiter drops the job
+    # P(no launch currently in flight solves this job); 1.0 = uncovered.
+    inflight_miss: float = 1.0
 
     def set_base(self, base: int) -> None:
         self.base = base & _MASK64
@@ -83,6 +105,12 @@ class _Job:
         self.difficulty = difficulty
         self.params[search.DIFF_LO] = difficulty & 0xFFFFFFFF
         self.params[search.DIFF_HI] = difficulty >> 32
+        # In-flight spans were dispatched at the OLD (easier) target and are
+        # now far less likely to solve this job; treating it as still
+        # covered would stall the raised request behind stale launches.
+        # Resetting to uncovered makes it immediately eligible again (the
+        # per-launch divide-back then clamps at 1.0 — see _apply_results).
+        self.inflight_miss = 1.0
 
 
 @dataclass
@@ -95,6 +123,7 @@ class _Launch:
     bases: list  # per-job scan base at dispatch (pre-speculation)
     span: int  # nonces scanned per row this launch
     shape: tuple  # (batch, steps) — warmed on success
+    miss_factors: list  # per-job P(this span misses), undone when applied
 
 
 class JaxWorkBackend(WorkBackend):
@@ -207,8 +236,10 @@ class JaxWorkBackend(WorkBackend):
         # their base SPECULATIVELY at dispatch (assuming the predecessor
         # misses); a predecessor hit just resolves the job and the
         # successor's now-useless lane result is discarded, identical to the
-        # cancel-in-flight race. Worst-case cancel latency grows to
-        # pipeline * run_steps windows.
+        # cancel-in-flight race. Successor launches prefer UNCOVERED demand
+        # over re-scanning jobs already likely solved in flight
+        # (_dispatch_next's coverage accounting). Worst-case cancel latency
+        # grows to pipeline * run_steps windows.
         self.pipeline = max(1, pipeline)
         if step_ladder not in ("x4", "x2"):
             raise WorkError(f"step_ladder must be 'x4' or 'x2', not {step_ladder!r}")
@@ -591,9 +622,20 @@ class JaxWorkBackend(WorkBackend):
             self._jobs.clear()
             raise
 
+    @staticmethod
+    def _miss_factor(difficulty: int, span: int) -> float:
+        """P(a span of ``span`` nonces holds no solution at ``difficulty``).
+
+        Floored away from 0.0 so the divide-back in _apply_results can
+        never divide by an underflowed exp() (easy difficulties make
+        span*p large enough to underflow).
+        """
+        p = (2**64 - difficulty) / 2**64
+        return max(math.exp(-span * p), 1e-12)
+
     def _dispatch_next(self) -> "Optional[_Launch]":
         """Pack and submit one launch for the next difficulty rung, or None
-        when no uncancelled jobs exist.
+        when nothing is worth dispatching.
 
         Difficulty-adaptive run length, decoupled across difficulty
         classes: jobs are grouped into rungs by the run length their
@@ -601,6 +643,16 @@ class JaxWorkBackend(WorkBackend):
         a hard request's wide launch never stretches every easy request's
         pass — and easy floods can't starve the hard rung either. Batch and
         steps then clamp to warmed shapes.
+
+        Selection within the demand is COVERAGE-AWARE: jobs whose in-flight
+        spans are already likely to solve them (inflight_miss below
+        SPEC_MISS_THRESHOLD) yield to uncovered jobs — under load a
+        pipelined successor launch serves the QUEUE, not a re-scan of the
+        batch already on the device. Only when every alive job is covered
+        does the engine speculate past the threshold (down to
+        SPEC_MISS_FLOOR): for a lone request that speculation hides the
+        readback round trip from the unlucky tail, and there is no queued
+        demand it could starve.
 
         Each included job's base advances SPECULATIVELY here, so a
         successor launch dispatched while this one is still in flight scans
@@ -613,12 +665,41 @@ class JaxWorkBackend(WorkBackend):
         rungs: Dict[int, list] = {}
         for j in alive:
             rungs.setdefault(self._steps_for(j.difficulty), []).append(j)
-        steps_want = self._next_rung(rungs)
-        active = rungs[steps_want][: self.max_batch]
+        speculative = False
+        for cutoff in (SPEC_MISS_THRESHOLD, SPEC_MISS_FLOOR):
+            cands = {
+                k: js
+                for k, js in (
+                    (k, [j for j in js if j.inflight_miss >= cutoff])
+                    for k, js in rungs.items()
+                )
+                if js
+            }
+            if cands:
+                break
+            speculative = True  # past the threshold pass: all demand covered
+        else:
+            return None  # everything in flight is near-certain to solve
+        steps_want = self._next_rung(cands)
+        # Least-covered first (ties keep insertion order: oldest job wins).
+        pool = sorted(cands[steps_want], key=lambda j: -j.inflight_miss)
+        if speculative:
+            # Bound the expected wasted device time (see SPEC_WASTE_ROWS).
+            active, waste = [], 0.0
+            for j in pool:
+                waste += 1.0 - j.inflight_miss
+                if active and waste > SPEC_WASTE_ROWS:
+                    break
+                active.append(j)
+                if len(active) == self.max_batch:
+                    break
+        else:
+            active = pool[: self.max_batch]
         b, steps = self._pick_shape(len(active), steps_want)
         active = active[:b]
         params = self._pack(active, b)
         span = self.chunk * steps
+        factors = [self._miss_factor(j.difficulty, span) for j in active]
         rec = _Launch(
             fut=self._submit_launch(params, steps),
             jobs=active,
@@ -629,13 +710,19 @@ class JaxWorkBackend(WorkBackend):
             bases=[j.base for j in active],
             span=span,
             shape=(params.shape[0], steps),
+            miss_factors=factors,
         )
-        for job in active:
+        for job, f in zip(active, factors):
             job.set_base(job.base + span)
+            job.inflight_miss *= f
         return rec
 
     def _apply_results(self, rec: "_Launch", lo_arr, hi_arr) -> None:
         self._warm.add(rec.shape)  # organic warming
+        for job, f in zip(rec.jobs, rec.miss_factors):
+            # This launch is no longer in flight: undo its coverage factor
+            # (clamped — repeated multiply/divide may drift past 1.0).
+            job.inflight_miss = min(1.0, job.inflight_miss / f)
         for job, launched, base, lo, hi in zip(
             rec.jobs, rec.launched_difficulty, rec.bases,
             lo_arr[: len(rec.jobs)], hi_arr[: len(rec.jobs)],
@@ -677,6 +764,11 @@ class JaxWorkBackend(WorkBackend):
         while not self._closed:
             if not inflight:
                 self._gc_jobs()
+                for j in self._jobs.values():
+                    # Pipe fully drained ⇒ nothing is in flight by
+                    # definition; snap out any float drift from the
+                    # multiply/divide coverage accounting.
+                    j.inflight_miss = 1.0
                 if not self._jobs:
                     self._wakeup.clear()
                     try:
