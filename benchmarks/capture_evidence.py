@@ -14,13 +14,15 @@ Order:
   5. fairness            — mixed-load scheduling tax
   6. overhead            — engine overhead decomposition
 
-Usage: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/capture_evidence.py
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/capture_evidence.py \
+           [--steps headline,flood,...]   (default: all, in priority order)
 """
 
 from __future__ import annotations
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
 
+import argparse
 import json
 import os
 import subprocess
@@ -65,9 +67,21 @@ def save(data: dict) -> None:
 
 
 def main() -> int:
+    p = argparse.ArgumentParser("priority-ordered on-chip evidence capture")
+    p.add_argument("--steps", default=None,
+                   help="comma-separated subset of step names (priority order kept)")
+    args = p.parse_args()
+    steps = STEPS
+    if args.steps:
+        want = {s.strip() for s in args.steps.split(",")}
+        unknown = want - {n for n, _, _ in STEPS}
+        if unknown:
+            print(f"unknown steps: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        steps = [s for s in STEPS if s[0] in want]
     results = load()
     results["capture_started_unix"] = round(time.time(), 1)
-    for name, cmd, timeout in STEPS:
+    for name, cmd, timeout in steps:
         print(f"== {name}: {' '.join(cmd)}", flush=True)
         t0 = time.time()
         try:

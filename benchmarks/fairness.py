@@ -48,6 +48,7 @@ async def run(n: int, flood_width: int, multiplier: float) -> None:
     hard = nc.derive_work_difficulty(multiplier, base)
     backend = get_backend("jax")
     await backend.setup()
+    await _bootstrap.wait_for_warmup(backend)  # steady-state, not compile queueing
 
     # Solo baseline: the 8x request with the engine to itself.
     solo = [await timed_hard(backend, hard) for _ in range(n)]

@@ -38,6 +38,12 @@ async def run(n: int, difficulty: int, backend_name: str, step_ladder: str = "x4
     kwargs = {"step_ladder": step_ladder} if backend_name == "jax" else {}
     backend = get_backend(backend_name, **kwargs)
     await backend.setup()
+    # Steady-state measurement: round 3's first capture timed solves while
+    # the launch-shape warmup was still compiling, so most ran at steps=1
+    # (an extra round trip each) and p50 read ~2x the warm engine.
+    t_warm = time.perf_counter()
+    await _bootstrap.wait_for_warmup(backend)
+    warm_wait_s = round(time.perf_counter() - t_warm, 1)
     times = []
     for _ in range(n):
         h = RNG.bytes(32).hex().upper()
@@ -57,6 +63,7 @@ async def run(n: int, difficulty: int, backend_name: str, step_ladder: str = "x4
                 "p50_ms": round(float(np.percentile(ms, 50)), 2),
                 "p95_ms": round(float(np.percentile(ms, 95)), 2),
                 "mean_ms": round(float(ms.mean()), 2),
+                "warm_wait_s": warm_wait_s,
             }
         )
     )

@@ -474,12 +474,18 @@ class JaxWorkBackend(WorkBackend):
             counts.append(steps)
         return counts
 
+    @staticmethod
+    def _solve_p(difficulty: int) -> float:
+        """Per-nonce solve probability, floored away from 0.0 (difficulty
+        can be 2^64-1) — the one probability model shared by rung sizing
+        (_steps_for) and coverage accounting (_miss_factor)."""
+        return max((2**64 - difficulty) / 2**64, 1e-30)
+
     def _steps_for(self, difficulty: int) -> int:
         """Windows one launch should cover for this difficulty: enough that
         the median solve finishes in a single round trip (2x the median
         window count), clamped to the run_steps cancel-latency cap."""
-        p = (2**64 - difficulty) / 2**64
-        median = math.log(2) / max(p, 1e-30)
+        median = math.log(2) / self._solve_p(difficulty)
         windows = 2 * median / self.chunk
         for steps in self._step_counts():
             if steps >= windows:
@@ -622,16 +628,15 @@ class JaxWorkBackend(WorkBackend):
             self._jobs.clear()
             raise
 
-    @staticmethod
-    def _miss_factor(difficulty: int, span: int) -> float:
+    @classmethod
+    def _miss_factor(cls, difficulty: int, span: int) -> float:
         """P(a span of ``span`` nonces holds no solution at ``difficulty``).
 
         Floored away from 0.0 so the divide-back in _apply_results can
         never divide by an underflowed exp() (easy difficulties make
         span*p large enough to underflow).
         """
-        p = (2**64 - difficulty) / 2**64
-        return max(math.exp(-span * p), 1e-12)
+        return max(math.exp(-span * cls._solve_p(difficulty)), 1e-12)
 
     def _dispatch_next(self) -> "Optional[_Launch]":
         """Pack and submit one launch for the next difficulty rung, or None
