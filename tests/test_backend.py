@@ -692,6 +692,26 @@ def test_difficulty_raise_resets_coverage():
     asyncio.run(run())
 
 
+def test_compilation_cache_populates(tmp_path):
+    """enable_compilation_cache must actually produce on-disk executables a
+    restarted worker can reload — the knob exists to skip the per-shape
+    compile wall (tens of seconds each through a remote-chip tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dpow.utils import enable_compilation_cache
+
+    try:
+        enable_compilation_cache(str(tmp_path), min_compile_secs=0.0)
+        jax.jit(lambda a: jnp.sin(a) @ a.T)(
+            np.ones((32, 32), np.float32)
+        ).block_until_ready()
+        assert any(tmp_path.iterdir()), "no cache entry written"
+    finally:  # global jax config: restore for the rest of the suite
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def test_mixed_load_rung_fairness_under_flood():
     """Adversarial mix (the benchmarks/fairness.py shape, deterministic):
     a sustained easy flood plus one unreachable-hard job. Round-robin rung

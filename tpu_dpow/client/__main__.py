@@ -26,6 +26,10 @@ async def amain(argv=None) -> None:
 
     config = parse_args(argv)
     get_logger("tpu_dpow.client", file_path=config.log_file)
+    if config.compilation_cache:
+        from ..utils import enable_compilation_cache
+
+        enable_compilation_cache(config.compilation_cache)
     # client_id must be stable across restarts (durable session: offline
     # QoS-1 cancel/client replay) but UNIQUE per worker — payout address
     # alone collides when a fleet shares one payout, and the broker's

@@ -28,6 +28,10 @@ class ClientConfig:
     work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
     client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
     log_file: Optional[str] = None
+    # Persistent XLA compilation cache dir ("" = off). A restarted worker
+    # reloads the launch-shape ladder's executables instead of re-paying
+    # each compile (tens of seconds per shape through a remote-chip tunnel).
+    compilation_cache: str = ""
 
     def __post_init__(self):
         if self.run_steps < 0:
@@ -81,5 +85,9 @@ def parse_args(argv=None) -> ClientConfig:
                    "several workers on one machine, or they take over each "
                    "other's session)")
     p.add_argument("--log_file", default=None)
+    p.add_argument("--compilation_cache", default=c.compilation_cache,
+                   help="persistent XLA compilation cache dir: a restarted "
+                   "worker reloads its launch-shape executables instead of "
+                   "recompiling the whole ladder (backend=jax; '' = off)")
     ns = p.parse_args(argv)
     return ClientConfig(**vars(ns))

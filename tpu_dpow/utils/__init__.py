@@ -35,6 +35,38 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", value)
 
 
+def enable_compilation_cache(path: str, *, min_compile_secs: float = 1.0) -> None:
+    """Point JAX's persistent compilation cache at ``path``.
+
+    Every distinct (batch, steps) launch shape is a separate XLA compile —
+    tens of seconds each through a remote-chip tunnel — and the engine's
+    warm ladder re-pays all of them on every process start. With the cache
+    enabled, a restarted worker reloads the ladder's executables from disk
+    instead (subject to the backend supporting serialization; harmless
+    no-op where it does not). ``min_compile_secs`` skips caching trivial
+    compiles (set 0.0 to cache everything, e.g. in tests).
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
+    # Cache regardless of backend identity quirks (the axon plugin reports
+    # an experimental platform; 'all' lets entries round-trip anyway).
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except AttributeError:  # older jax without the sub-cache knob
+        pass
+    # jax latches the enabled/disabled decision at the first compile; a
+    # process that compiled anything before this call (engine self-test,
+    # another backend) would silently never cache without a reset.
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - reset is best-effort by version
+        pass
+
+
 def maybe_init_distributed() -> None:
     """Entrypoint hook: join a multi-host slice iff TPU_DPOW_COORDINATOR set.
 

@@ -24,6 +24,8 @@ async def amain(argv=None) -> None:
                    help="native backend thread count")
     p.add_argument("--mesh_devices", type=int, default=1,
                    help="gang N local devices per hash (backend=jax)")
+    p.add_argument("--compilation_cache", default="",
+                   help="persistent XLA compilation cache dir ('' = off)")
     p.add_argument("--verbose", action="store_true")
     ns = p.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if ns.verbose else logging.INFO)
@@ -31,6 +33,10 @@ async def amain(argv=None) -> None:
     from ..utils import honor_jax_platforms_env
 
     honor_jax_platforms_env()
+    if ns.compilation_cache:
+        from ..utils import enable_compilation_cache
+
+        enable_compilation_cache(ns.compilation_cache)
     from ..utils import maybe_init_distributed
 
     maybe_init_distributed()
