@@ -70,6 +70,10 @@ def main() -> int:
     p = argparse.ArgumentParser("priority-ordered on-chip evidence capture")
     p.add_argument("--steps", default=None,
                    help="comma-separated subset of step names (priority order kept)")
+    p.add_argument("--mark", default=None,
+                   help="tag each recorded step with this truthy marker key "
+                   "(lets a re-capture watcher distinguish fresh results "
+                   "from a previous code revision's)")
     args = p.parse_args()
     steps = STEPS
     if args.steps:
@@ -103,6 +107,8 @@ def main() -> int:
                 record["stderr_tail"] = (proc.stderr or "").strip().splitlines()[-3:]
         except subprocess.TimeoutExpired:
             record = {"rc": "timeout", "seconds": round(time.time() - t0, 1)}
+        if args.mark:
+            record[args.mark] = True
         results[name] = record
         save(results)  # progressive: a dead tunnel still leaves earlier steps
         print(f"   -> {json.dumps(record)[:240]}", flush=True)
