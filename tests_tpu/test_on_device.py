@@ -261,6 +261,46 @@ def test_backend_run_mode_and_warm_shapes_on_device():
     asyncio.run(run())
 
 
+def test_backend_overscan_bounded_on_device():
+    """Round-3 regression, on the real chip: with more demand than one
+    batch holds, pipelined dispatch must not re-scan covered jobs — total
+    device hashes per solve stays near the 1/p hash bound. The uncapped
+    speculation this pins against measured ~2x the bound (123M vs 67M
+    hashes/solve at base difficulty, batch-64) and halved solves/s."""
+    import asyncio
+
+    from tpu_dpow.backend.jax_backend import JaxWorkBackend
+    from tpu_dpow.models import WorkRequest
+    from tpu_dpow.utils import nanocrypto as nc
+
+    # p = 2^-24: ~16.7M expected hashes/solve, ~0.4s of device for the
+    # whole batch at production-like geometry.
+    difficulty = (1 << 64) - (1 << 40)
+    n = 24
+
+    async def run():
+        b = JaxWorkBackend(sublanes=32, iters=1024, nblocks=2, group=8,
+                           max_batch=8, pipeline=2, run_steps=4,
+                           warm_shapes=False)
+        await b.setup()
+        reqs = [
+            WorkRequest(secrets.token_bytes(32).hex().upper(), difficulty)
+            for _ in range(n)
+        ]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, difficulty)
+        per_solve = b.total_hashes / n
+        await b.close()
+        bound = 1.6 * 2**24  # mean 1.0/p, sigma ~0.2/p at n=24: ~3 sigma
+        assert per_solve < bound, (
+            f"{per_solve/2**24:.2f}x the hash bound per solve - "
+            "covered jobs are being re-scanned"
+        )
+
+    asyncio.run(run())
+
+
 def test_backend_pipelined_launches_on_device():
     """Round-3 launch pipelining on the real chip: overlapping launches
     with speculative base advancement must still produce hashlib-valid
