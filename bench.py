@@ -58,6 +58,17 @@ def measure(reps: int = 8) -> dict:
 
     from tpu_dpow.ops import pallas_kernel, search
 
+    try:
+        # Persist compiled executables across bench children/driver runs:
+        # retry attempts (and future rounds on this machine) then skip the
+        # cold-compile window entirely. Best-effort — harmless where the
+        # backend cannot serialize executables.
+        from tpu_dpow.utils import enable_compilation_cache
+
+        enable_compilation_cache("/tmp/tpu_dpow_jax_cache")
+    except Exception:
+        pass
+
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
 
