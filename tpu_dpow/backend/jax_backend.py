@@ -670,21 +670,19 @@ class JaxWorkBackend(WorkBackend):
         rungs: Dict[int, list] = {}
         for j in alive:
             rungs.setdefault(self._steps_for(j.difficulty), []).append(j)
-        speculative = False
         for cutoff in (SPEC_MISS_THRESHOLD, SPEC_MISS_FLOOR):
             cands = {
-                k: js
-                for k, js in (
-                    (k, [j for j in js if j.inflight_miss >= cutoff])
-                    for k, js in rungs.items()
-                )
-                if js
+                k: eligible
+                for k, js in rungs.items()
+                if (eligible := [j for j in js if j.inflight_miss >= cutoff])
             }
             if cands:
                 break
-            speculative = True  # past the threshold pass: all demand covered
         else:
             return None  # everything in flight is near-certain to solve
+        # Reaching the floor pass means all demand is covered: anything
+        # dispatched now is pure speculation.
+        speculative = cutoff == SPEC_MISS_FLOOR
         steps_want = self._next_rung(cands)
         # Least-covered first (ties keep insertion order: oldest job wins).
         pool = sorted(cands[steps_want], key=lambda j: -j.inflight_miss)
