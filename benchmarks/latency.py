@@ -58,6 +58,7 @@ async def run(n: int, difficulty: int, backend_name: str, step_ladder: str = "x4
             {
                 "bench": "single_request_latency",
                 "backend": backend_name,
+                "platform": jax.devices()[0].platform,
                 "difficulty": f"{difficulty:016x}",
                 "n": n,
                 "p50_ms": round(float(np.percentile(ms, 50)), 2),
