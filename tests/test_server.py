@@ -553,10 +553,12 @@ def test_impatient_waiter_teardown_does_not_break_dispatcher():
     run(main())
 
 
-def test_concurrent_base_and_raised_dispatch_single_publish():
+def test_concurrent_base_and_raised_dispatch_single_future():
     """Regression (TOCTOU): two dispatches racing for the same hash must not
     both enter the dispatch block — the reservation is synchronous, so only
-    ONE work message is published and the loser just waits."""
+    ONE future is created. The raised loser does not merely wait, though: it
+    RE-TARGETS the in-flight dispatch (one extra publish at the raised
+    difficulty), and both waiters resolve to the same work."""
 
     async def main():
         async with Harness() as hx:
@@ -571,6 +573,187 @@ def test_concurrent_base_and_raised_dispatch_single_publish():
             )
             assert a == b
             await asyncio.sleep(0.05)
-            assert len([m for m in hx.worker_log if m.topic.startswith("work/")]) == 1
+            work_msgs = [m for m in hx.worker_log if m.topic.startswith("work/")]
+            assert [m.payload for m in work_msgs] == [
+                f"{h},{EASY_BASE:016x}",  # base dispatch
+                f"{h},{raised:016x}",     # the raised waiter's re-target
+            ]
+            # teardown left no in-flight bookkeeping behind
+            assert h not in hx.server.work_futures
+            assert h not in hx.server._dispatched_difficulty
+
+    run(main())
+
+
+def solve_between(block_hash: str, lo: int, hi: int) -> str:
+    """Work whose value meets ``lo`` but NOT ``hi`` (a deliberately weak
+    solution for retarget tests)."""
+    h = bytes.fromhex(block_hash)
+    w = 0
+    while True:
+        v = int.from_bytes(
+            hashlib.blake2b(struct.pack("<Q", w) + h, digest_size=8).digest(), "little"
+        )
+        if lo <= v < hi:
+            return f"{w:016x}"
+        w += 1
+
+
+async def wait_until(cond, timeout: float = 5.0):
+    t0 = asyncio.get_running_loop().time()
+    while not cond():
+        if asyncio.get_running_loop().time() - t0 > timeout:
+            raise AssertionError("condition not reached")
+        await asyncio.sleep(0.01)
+
+
+def test_raised_request_retargets_inflight_dispatch():
+    """THE reference hole this framework closes (dpow_server.py:310-329): a
+    raised-difficulty request for a hash already dispatched at base used to
+    piggyback on the weak dispatch — await weak work, fail final validation,
+    bounce the service through RetryRequest. Here it must re-target: bump
+    ``block-difficulty:`` (so the result handler discards weaker results)
+    and re-publish at the raised target; BOTH requests then succeed off the
+    strong result, with no RetryRequest anywhere."""
+
+    async def main():
+        async with Harness() as hx:
+            t = await hx.start_worker(respond=False)  # observe, don't solve
+            h = random_hash()
+            raised = nc.derive_work_difficulty(4.0, EASY_BASE)
+
+            base_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, timeout=10))
+            )
+            await wait_until(
+                lambda: any(m.topic == "work/ondemand" for m in hx.worker_log)
+            )
+            raised_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, multiplier=4.0, timeout=10))
+            )
+            await wait_until(
+                lambda: sum(m.topic == "work/ondemand" for m in hx.worker_log) >= 2
+            )
+            payloads = [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
+            assert payloads == [f"{h},{EASY_BASE:016x}", f"{h},{raised:016x}"]
+            assert await hx.store.get(f"block-difficulty:{h}") == f"{raised:016x}"
+
+            # A result that would have satisfied the ORIGINAL dispatch is now
+            # too weak — the result handler must discard it without claiming
+            # the winner lock or resolving anyone's future.
+            weak = solve_between(h, EASY_BASE, raised)
+            await t.publish("result/ondemand", f"{h},{weak},{ACCOUNT}")
+            await asyncio.sleep(0.1)
+            assert not base_task.done() and not raised_task.done()
+            assert await hx.store.get(f"block:{h}") == WORK_PENDING
+            assert await hx.store.get(f"block-lock:{h}") is None
+
+            # The strong result satisfies BOTH waiters.
+            strong = solve(h, raised)
+            await t.publish("result/ondemand", f"{h},{strong},{ACCOUNT}")
+            base_resp, raised_resp = await asyncio.gather(base_task, raised_task)
+            assert base_resp["work"] == strong and raised_resp["work"] == strong
+            nc.validate_work(h, raised_resp["work"], raised)
+
+    run(main())
+
+
+def test_raise_landing_mid_dispatch_is_not_clobbered():
+    """Race regression: a raiser can slip in while the dispatcher is still
+    suspended in its dispatch store-writes. The dispatcher's base-path
+    block-difficulty cleanup runs AFTER the raiser's bump — unserialized it
+    would erase the raised target, the result handler would accept weak
+    work, and the raiser would bounce through RetryRequest (the exact hole
+    the retarget path closes). The difficulty-entry writes are serialized
+    under _raise_lock against the in-memory high-water mark."""
+
+    async def main():
+        async with Harness() as hx:
+            t = await hx.start_worker(respond=False)
+            h = random_hash()
+            raised = nc.derive_work_difficulty(4.0, EASY_BASE)
+
+            gate = asyncio.Event()
+            orig_set = hx.store.set
+
+            async def gated_set(key, *a, **kw):
+                if key.startswith("work-type:"):
+                    await gate.wait()  # park the dispatcher mid-dispatch
+                return await orig_set(key, *a, **kw)
+
+            hx.store.set = gated_set
+            base_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, timeout=10))
+            )
+            await asyncio.sleep(0.05)  # dispatcher reserved, parked in set()
+            raised_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, multiplier=4.0, timeout=10))
+            )
+            await wait_until(
+                lambda: any(
+                    m.topic == "work/ondemand"
+                    and m.payload == f"{h},{raised:016x}"
+                    for m in hx.worker_log
+                )
+            )
+            gate.set()  # dispatcher resumes its base-path cleanup
+            await wait_until(
+                lambda: sum(m.topic == "work/ondemand" for m in hx.worker_log) >= 2
+            )
+            await asyncio.sleep(0.05)
+            # the raised target survived the dispatcher's resume
+            assert await hx.store.get(f"block-difficulty:{h}") == f"{raised:016x}"
+            # AND the dispatcher's own (later) publish went out at the
+            # raised target too — its base-target message would strand a
+            # worker on work the result handler no longer accepts if the
+            # raiser's QOS_0 publish were the one that got lost.
+            assert all(
+                m.payload == f"{h},{raised:016x}"
+                for m in hx.worker_log
+                if m.topic == "work/ondemand"
+            ), [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
+
+            weak = solve_between(h, EASY_BASE, raised)
+            await t.publish("result/ondemand", f"{h},{weak},{ACCOUNT}")
+            await asyncio.sleep(0.1)
+            assert not base_task.done() and not raised_task.done()
+
+            strong = solve(h, raised)
+            await t.publish("result/ondemand", f"{h},{strong},{ACCOUNT}")
+            base_resp, raised_resp = await asyncio.gather(base_task, raised_task)
+            assert base_resp["work"] == strong and raised_resp["work"] == strong
+
+    run(main())
+
+
+def test_raised_request_noop_when_inflight_already_stronger():
+    """The inverse ordering: a BASE request joining a dispatch already
+    published at a higher difficulty needs no re-target (the strong work
+    satisfies it) — no extra publish, no block-difficulty downgrade."""
+
+    async def main():
+        async with Harness() as hx:
+            t = await hx.start_worker(respond=False)
+            h = random_hash()
+            raised = nc.derive_work_difficulty(4.0, EASY_BASE)
+
+            raised_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, multiplier=4.0, timeout=10))
+            )
+            await wait_until(
+                lambda: any(m.topic == "work/ondemand" for m in hx.worker_log)
+            )
+            base_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, timeout=10))
+            )
+            await asyncio.sleep(0.1)
+            payloads = [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
+            assert payloads == [f"{h},{raised:016x}"]  # no second publish
+            assert await hx.store.get(f"block-difficulty:{h}") == f"{raised:016x}"
+
+            strong = solve(h, raised)
+            await t.publish("result/ondemand", f"{h},{strong},{ACCOUNT}")
+            base_resp, raised_resp = await asyncio.gather(base_task, raised_task)
+            assert base_resp["work"] == strong and raised_resp["work"] == strong
 
     run(main())

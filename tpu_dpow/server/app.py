@@ -64,6 +64,22 @@ class DpowServer:
         )
         self.work_futures: Dict[str, asyncio.Future] = {}
         self._future_waiters: Dict[str, int] = {}
+        # Highest difficulty PUBLISHED for each in-flight dispatch. Lets a
+        # later raised-difficulty request re-target the running work instead
+        # of piggybacking on the weaker dispatch and bouncing through
+        # RetryRequest (the reference has exactly that hole:
+        # dpow_server.py:310-329 awaits whatever future exists, at whatever
+        # difficulty it was published). Entries live and die with the
+        # work_futures entry for the same hash.
+        self._dispatched_difficulty: Dict[str, int] = {}
+        # Per-hash: serializes the dispatcher's difficulty-entry write with
+        # concurrent raisers for the SAME hash, so interleaved store writes
+        # cannot leave `block-difficulty:` below what was last published.
+        # Per-hash (not one global lock) because the dispatcher holds it
+        # across store+publish awaits on EVERY dispatch — a global lock
+        # would serialize unrelated hashes' dispatches behind each other's
+        # round trips. Entries live and die with work_futures.
+        self._difficulty_locks: Dict[str, asyncio.Lock] = {}
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
         self._tasks: list = []
@@ -467,6 +483,7 @@ class DpowServer:
             # erase a raised entry and fail its final validation).
             created = asyncio.get_running_loop().create_future()
             self.work_futures[block_hash] = created
+            self._dispatched_difficulty[block_hash] = difficulty
             try:
                 if account:
                     asyncio.ensure_future(
@@ -476,22 +493,43 @@ class DpowServer:
                     )
                 await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
                                      expire=self.config.block_expiry)
-                if difficulty != self.config.base_difficulty:
-                    await self.store.set(
-                        f"block-difficulty:{block_hash}",
-                        f"{difficulty:016x}",
-                        expire=self.config.difficulty_expiry,
+                # Serialized with concurrent raisers (_raise_lock): a raiser
+                # that slipped in while this dispatcher was suspended in the
+                # store writes above has already bumped `block-difficulty:`
+                # — writing (or, worse, deleting) our weaker target AFTER
+                # its bump would make the result handler accept too-weak
+                # work and bounce the raiser through RetryRequest, the exact
+                # hole the retarget path exists to close. Under the lock the
+                # in-memory high-water mark is authoritative.
+                async with self._difficulty_locks.setdefault(
+                    block_hash, asyncio.Lock()
+                ):
+                    effective = max(
+                        difficulty,
+                        self._dispatched_difficulty.get(block_hash, difficulty),
                     )
-                else:
-                    # A previous raised-difficulty dispatch for this hash may
-                    # have timed out inside the 120 s TTL; its leftover entry
-                    # would make the result handler validate THIS base-difficulty
-                    # dispatch against the old higher target and discard valid
-                    # work. Clear it so validation matches what was asked for.
-                    await self.store.delete(f"block-difficulty:{block_hash}")
-                await self.transport.publish(
-                    "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
-                )
+                    if effective != self.config.base_difficulty:
+                        await self.store.set(
+                            f"block-difficulty:{block_hash}",
+                            f"{effective:016x}",
+                            expire=self.config.difficulty_expiry,
+                        )
+                    else:
+                        # A previous raised-difficulty dispatch for this hash
+                        # may have timed out inside the 120 s TTL; its
+                        # leftover entry would make the result handler
+                        # validate THIS base-difficulty dispatch against the
+                        # old higher target and discard valid work. Clear it
+                        # so validation matches what was asked for.
+                        await self.store.delete(f"block-difficulty:{block_hash}")
+                    # Publish at the SAME effective target, inside the lock:
+                    # the raiser's own QOS_0 publish can be lost, and a
+                    # worker arriving between the two publishes would
+                    # otherwise grind at a target the result handler no
+                    # longer accepts — with nothing left to re-publish.
+                    await self.transport.publish(
+                        "work/ondemand", f"{block_hash},{effective:016x}", qos=QOS_0
+                    )
             except BaseException:
                 # A failed dispatch must not leave a never-resolved future
                 # that later requests for this hash would silently wait on.
@@ -501,6 +539,8 @@ class DpowServer:
                 # the successor's future out from under it.
                 if self.work_futures.get(block_hash) is created:
                     del self.work_futures[block_hash]
+                    self._dispatched_difficulty.pop(block_hash, None)
+                    self._difficulty_locks.pop(block_hash, None)
                 if not created.done():
                     created.cancel()
                 raise
@@ -514,6 +554,58 @@ class DpowServer:
         fut = created if created is not None else self.work_futures[block_hash]
         self._future_waiters[block_hash] = self._future_waiters.get(block_hash, 0) + 1
         try:
+            if created is None and difficulty > self._dispatched_difficulty.get(
+                block_hash, self.config.base_difficulty
+            ):
+                # The in-flight dispatch was published at a weaker target
+                # than this request needs. Awaiting it anyway would hand us
+                # too-weak work and force a RetryRequest at final validation
+                # — so RE-TARGET instead: bump `block-difficulty:` (the
+                # result handler now discards weaker results) and re-publish
+                # at the raised target. The worker side threads the raise
+                # into its running job (client/work_handler.py queue_work;
+                # backend raise_difficulty). Inside the waiter try-block so a
+                # failed publish still tears down our refcount.
+                async with self._difficulty_locks.setdefault(
+                    block_hash, asyncio.Lock()
+                ):
+                    current = self._dispatched_difficulty.get(
+                        block_hash, self.config.base_difficulty
+                    )
+                    # fut.done(): a result can land between the unlocked
+                    # pre-check and here — re-targeting then would park a
+                    # stale raised `block-difficulty:` (full TTL) and burn
+                    # worker lanes on a hash whose result the handler will
+                    # drop at the not-WORK_PENDING check.
+                    if (
+                        difficulty > current
+                        and self.work_futures.get(block_hash) is fut
+                        and not fut.done()
+                    ):
+                        # Bump the high-water mark only once BOTH the store
+                        # write and the publish landed: bumping first with
+                        # no rollback would make a transient store/broker
+                        # error permanently disable re-targeting for this
+                        # hash (every retry would see difficulty > current
+                        # as false and skip the re-publish).
+                        self._dispatched_difficulty[block_hash] = difficulty
+                        try:
+                            await self.store.set(
+                                f"block-difficulty:{block_hash}",
+                                f"{difficulty:016x}",
+                                expire=self.config.difficulty_expiry,
+                            )
+                            await self.transport.publish(
+                                "work/ondemand",
+                                f"{block_hash},{difficulty:016x}",
+                                qos=QOS_0,
+                            )
+                        except BaseException:
+                            self._dispatched_difficulty[block_hash] = current
+                            raise
+                        logger.info(
+                            "re-targeted in-flight %s to %016x", block_hash, difficulty
+                        )
             work = await asyncio.wait_for(asyncio.shield(fut), timeout=timeout)
         except asyncio.CancelledError:
             # Future cancelled under us: the result may still have landed in
@@ -536,6 +628,8 @@ class DpowServer:
                 # dispatch's fresh future, which must stay.
                 if self.work_futures.get(block_hash) is fut:
                     del self.work_futures[block_hash]
+                    self._dispatched_difficulty.pop(block_hash, None)
+                    self._difficulty_locks.pop(block_hash, None)
                 if not fut.done():
                     fut.cancel()
             else:
