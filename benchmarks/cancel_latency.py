@@ -1,0 +1,109 @@
+"""Cancel drain latency: cancel-received → device lanes actually free.
+
+A cancel resolves the requester's future immediately (client-visible cancel
+is ~0 ms), but the device is still grinding the cancelled job's in-flight
+launches — a fresh request dispatched right after the cancel waits behind
+that residue. Cancel is the reference's latency-critical control edge
+(SURVEY.md §3.5: a worker grinding a stale hash is a worker lost to the
+swarm); here the analog is lanes parked on a cancelled hash.
+
+Measured as the OPERATIONAL definition: time from cancel() of a hard
+in-flight job to a fresh easy request's work arriving, vs the same easy
+request's solo latency on an idle engine. added_p50_ms is the drain tax.
+
+The engine bounds it by construction: only the head-of-queue launch may run
+full run_steps width; pipelined successors are capped at shared_steps_cap
+windows (backend/jax_backend.py _dispatch_next), so worst-case residue is
+run_steps + (pipeline-1)*shared_steps_cap windows of scan.
+
+Usage: python benchmarks/cancel_latency.py [--n 10] [--settle 0.25]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+from tpu_dpow.backend import WorkCancelled, get_backend
+from tpu_dpow.models import WorkRequest
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0xCA)
+UNREACHABLE = (1 << 64) - 2  # keeps every lane busy until the cancel
+
+
+async def run(n: int, settle: float) -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    easy = nc.derive_work_difficulty(1.0)
+    if platform != "tpu":
+        easy = min(easy, 0xFFF0000000000000)  # keep CPU runs sane
+    backend = get_backend("jax")
+    await backend.setup()
+    await _bootstrap.wait_for_warmup(backend)
+
+    solo, post_cancel = [], []
+    for _ in range(n):
+        # Solo baseline: easy request on an idle engine.
+        h = RNG.bytes(32).hex().upper()
+        t0 = time.perf_counter()
+        await backend.generate(WorkRequest(h, easy))
+        solo.append(time.perf_counter() - t0)
+
+        # Drain trial: hard job fills the pipeline, then cancel + fresh easy.
+        hard = RNG.bytes(32).hex().upper()
+        t_hard = asyncio.ensure_future(
+            backend.generate(WorkRequest(hard, UNREACHABLE))
+        )
+        await asyncio.sleep(settle)  # pipeline fills with the hard job's scans
+        t0 = time.perf_counter()
+        await backend.cancel(hard)
+        h2 = RNG.bytes(32).hex().upper()
+        await backend.generate(WorkRequest(h2, easy))
+        post_cancel.append(time.perf_counter() - t0)
+        try:
+            await t_hard
+        except WorkCancelled:
+            pass
+
+    await backend.close()
+    solo_ms = np.asarray(sorted(solo)) * 1e3
+    drain_ms = np.asarray(sorted(post_cancel)) * 1e3
+    print(
+        json.dumps(
+            {
+                "bench": "cancel_drain_latency",
+                "platform": platform,
+                "n": n,
+                "solo_p50_ms": round(float(np.percentile(solo_ms, 50)), 2),
+                "post_cancel_p50_ms": round(float(np.percentile(drain_ms, 50)), 2),
+                "post_cancel_p95_ms": round(float(np.percentile(drain_ms, 95)), 2),
+                "added_p50_ms": round(
+                    float(np.percentile(drain_ms, 50) - np.percentile(solo_ms, 50)), 2
+                ),
+                "bound_windows": backend.run_steps
+                + (backend.pipeline - 1) * backend.shared_steps_cap,
+                "geometry": {
+                    "run_steps": backend.run_steps,
+                    "pipeline": backend.pipeline,
+                    "shared_steps_cap": backend.shared_steps_cap,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=10)
+    p.add_argument("--settle", type=float, default=0.25,
+                   help="seconds to let the hard job fill the pipeline")
+    args = p.parse_args()
+    asyncio.run(run(args.n, args.settle))

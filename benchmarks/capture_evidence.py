@@ -44,6 +44,7 @@ STEPS = [
     ("flood", [sys.executable, "benchmarks/flood.py", "--n", "100",
                "--concurrency", "20"], 900),
     ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
+    ("cancel", [sys.executable, "benchmarks/cancel_latency.py", "--n", "10"], 600),
     ("overhead", [sys.executable, "benchmarks/overhead.py"], 900),
     ("batch", [sys.executable, "benchmarks/batch.py"], 600),
     ("soak", [sys.executable, "benchmarks/soak.py", "--waves", "10",
@@ -71,7 +72,7 @@ def main() -> int:
     p.add_argument("--steps", default=None,
                    help="comma-separated subset of step names (priority order kept)")
     p.add_argument("--mark", default=None,
-                   help="tag each recorded step with this truthy marker key "
+                   help="record this value under each step's 'mark' key "
                    "(lets a re-capture watcher distinguish fresh results "
                    "from a previous code revision's)")
     args = p.parse_args()
@@ -108,7 +109,10 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             record = {"rc": "timeout", "seconds": round(time.time() - t0, 1)}
         if args.mark:
-            record[args.mark] = True
+            # Namespaced under a fixed key: a free-form value must not be
+            # able to collide with (and overwrite) the reserved record keys
+            # rc/seconds/result/tail/stderr_tail.
+            record["mark"] = args.mark
         results[name] = record
         save(results)  # progressive: a dead tunnel still leaves earlier steps
         print(f"   -> {json.dumps(record)[:240]}", flush=True)

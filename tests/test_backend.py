@@ -568,15 +568,19 @@ async def _gated_recording_backend(**kw):
     real_launch = b._launch
 
     def gated(params, steps):
-        gate.wait(timeout=10)
+        # A timed-out wait must fail LOUDLY: proceeding ungated would fail
+        # the dispatch-record assertions downstream with an error that reads
+        # like a dispatch-policy regression instead of a slow-CI timeout.
+        if not gate.wait(timeout=10):
+            raise TimeoutError("gated-launch gate never released within 10s")
         return real_launch(params, steps)
 
     b._launch = gated
     real_dispatch = b._dispatch_next
     records = []
 
-    def recording():
-        rec = real_dispatch()
+    def recording(*args):
+        rec = real_dispatch(*args)
         if rec is not None:
             records.append([j.block_hash for j in rec.jobs])
         return rec
@@ -701,6 +705,7 @@ def test_compilation_cache_populates(tmp_path):
 
     from tpu_dpow.utils import enable_compilation_cache
 
+    prior_xla_caches = getattr(jax.config, "jax_persistent_cache_enable_xla_caches", None)
     try:
         enable_compilation_cache(str(tmp_path), min_compile_secs=0.0)
         jax.jit(lambda a: jnp.sin(a) @ a.T)(
@@ -710,6 +715,10 @@ def test_compilation_cache_populates(tmp_path):
     finally:  # global jax config: restore for the rest of the suite
         jax.config.update("jax_compilation_cache_dir", None)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        if prior_xla_caches is not None:
+            jax.config.update(
+                "jax_persistent_cache_enable_xla_caches", prior_xla_caches
+            )
 
 
 def test_mixed_load_rung_fairness_under_flood():
@@ -755,23 +764,123 @@ def test_mixed_load_rung_fairness_under_flood():
             await t_hard
         await b.close()
 
-        hard_n = sum(1 for s in window if s == 16)
+        # The hard rung launches NARROWED under contention (shared_steps_cap,
+        # default run_steps/4 = 4) — a full-width 16 in the mixed window
+        # would mean the flood waited half a second behind one launch.
+        hard_n = sum(1 for s in window if s > 1)
         easy_n = sum(1 for s in window if s == 1)
+        # A few full-width stragglers tolerated: a 16 can slip in while
+        # every flooder is momentarily between requests (hard rung truly
+        # alone on a drained pipe — more likely under CI/host contention).
+        # The regression signal is gross: pre-cap, ~half the window was 16s.
+        assert sum(1 for s in window if s == 16) <= 4, window
         # Round-robin over two live rungs → each gets ~half the launches;
         # a third is the regression bound (serving one rung only would put
         # the other at 0).
         assert hard_n >= len(window) // 3, window
         assert easy_n >= len(window) // 3, window
-        # And no rung monopolizes: never 4+ consecutive same-rung launches
-        # while both are pending.
+        # And no rung monopolizes: no long consecutive same-rung streaks
+        # while both are pending (host-contention jitter gets one of slack).
         run_len, worst, prev = 0, 0, None
         for s in window:
             run_len = run_len + 1 if s == prev else 1
             worst = max(worst, run_len)
             prev = s
-        assert worst <= 3, window
+        assert worst <= 4, window
 
     asyncio.run(run())
+
+
+def test_shared_steps_cap_narrows_contended_launches():
+    """A full-width launch parks run_steps windows of scan in front of every
+    other rung on the serial device queue — the entire cancel-latency /
+    mixed-load fairness tax. Under contention (another rung has live jobs)
+    the hard rung must narrow to shared_steps_cap (default run_steps/4); a
+    LONE hard job keeps the full-width single-round-trip launch (that launch
+    IS the <50 ms design)."""
+
+    async def run():
+        b = make_backend(run_steps=16, pipeline=2)
+        assert b.shared_steps_cap == 4
+        launches = []
+        orig = b._launch
+
+        def traced(params, steps):
+            launches.append(steps)
+            return orig(params, steps)
+
+        b._launch = traced
+        await b.setup()
+        launches.clear()
+        hard = random_hash()
+        t_hard = asyncio.ensure_future(b.generate(WorkRequest(hard, (1 << 64) - 2)))
+        t_easy = asyncio.ensure_future(b.generate(WorkRequest(random_hash(), EASY)))
+        while len(launches) < 2:
+            await asyncio.sleep(0.01)
+        # Round-robin starts at the easy rung; the hard launch right behind
+        # it is contended, so it is capped at 4, not 16.
+        assert launches[0] == 1 and launches[1] == 4, launches
+        assert await t_easy
+        await b.cancel(hard)
+        with pytest.raises(WorkCancelled):
+            await t_hard
+        # While the pipe stayed busy, every successor was capped — no 16
+        # ever queued behind in-flight work.
+        assert launches.count(16) == 0, launches
+        # A fresh hard job arriving on a DRAINED pipe gets the full-width
+        # single-round-trip head launch back.
+        await asyncio.sleep(0.3)  # in-flight CPU launches drain
+        launches.clear()
+        h2 = random_hash()
+        t2 = asyncio.ensure_future(b.generate(WorkRequest(h2, (1 << 64) - 2)))
+        while not launches:
+            await asyncio.sleep(0.01)
+        assert launches[0] == 16, launches
+        await b.cancel(h2)
+        with pytest.raises(WorkCancelled):
+            await t2
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_speculative_successor_launch_is_narrow():
+    """A pipelined successor for an already-covered job is pure speculation
+    (it only hides the readback bubble from the unlucky tail) — it must be
+    narrowed to shared_steps_cap: a second full-width launch would double
+    the wait for any arrival or cancel behind it for, at most, one round
+    trip of hidden latency."""
+    import math
+
+    async def run():
+        b = make_backend(run_steps=16, pipeline=2)
+        # Difficulty whose 2x-median wants ~12 windows: _steps_for picks the
+        # 16 rung, and one full launch covers the job to miss ≈ 0.16 —
+        # below SPEC_MISS_THRESHOLD (successor is speculative), above
+        # SPEC_MISS_FLOOR (successor still allowed).
+        p = math.log(2) / (6 * b.chunk)
+        d = (1 << 64) - int(p * (1 << 64))
+        assert b._steps_for(d) == 16
+        launches = []
+        orig = b._launch
+
+        def traced(params, steps):
+            launches.append(steps)
+            return orig(params, steps)
+
+        b._launch = traced
+        await b.setup()
+        launches.clear()
+        work = await b.generate(WorkRequest(random_hash(), d))
+        assert work
+        await b.close()
+        # First dispatch: lone uncovered job, full width. Its pipelined
+        # successor (dispatched in the same engine pass): speculative → 4.
+        assert launches[0] == 16, launches
+        if len(launches) > 1:  # the job can solve before a successor runs
+            assert launches[1] == 4, launches
+
+    asyncio.run(asyncio.wait_for(run(), 30))
 
 
 def test_step_ladder_options():

@@ -155,6 +155,7 @@ class JaxWorkBackend(WorkBackend):
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
         pipeline: int = 2,  # launches in flight at once (1 = no overlap)
         step_ladder: str = "x4",  # run-length quantization: 'x4' | 'x2'
+        shared_steps_cap: Optional[int] = None,  # windows/launch under contention
     ):
         if mesh_devices > 1:
             # local_devices: under a jax.distributed multi-host slice the
@@ -238,12 +239,27 @@ class JaxWorkBackend(WorkBackend):
         # successor's now-useless lane result is discarded, identical to the
         # cancel-in-flight race. Successor launches prefer UNCOVERED demand
         # over re-scanning jobs already likely solved in flight
-        # (_dispatch_next's coverage accounting). Worst-case cancel latency
-        # grows to pipeline * run_steps windows.
+        # (_dispatch_next's coverage accounting). Worst-case wait behind
+        # in-flight work is bounded by run_steps + (pipeline-1) *
+        # shared_steps_cap windows: only the head-of-queue launch may run
+        # full width (_dispatch_next's successor cap).
         self.pipeline = max(1, pipeline)
         if step_ladder not in ("x4", "x2"):
             raise WorkError(f"step_ladder must be 'x4' or 'x2', not {step_ladder!r}")
         self.step_ladder = step_ladder
+        # The device executes launches serially, so one steps=16 launch parks
+        # ~16 windows of scan in front of everything behind it — the whole
+        # cancel-latency / mixed-load fairness tax in one number. Under
+        # CONTENTION (another difficulty rung has eligible demand) or for
+        # purely SPECULATIVE launches (all demand already covered in flight),
+        # cap the run length: round trips per solve rise a little (the
+        # pipeline hides the readback either way), but nothing waits behind
+        # more than `shared_steps_cap` windows of someone else's scan. A
+        # lone uncovered hard job still gets the full run_steps width — that
+        # single-round-trip launch IS the <50 ms design (SURVEY.md §7).
+        if shared_steps_cap is None:
+            shared_steps_cap = max(1, self.run_steps // 4)
+        self.shared_steps_cap = max(1, min(shared_steps_cap, self.run_steps))
         self._warm: set = set()
         self._warm_task: Optional[asyncio.Task] = None
         # Dedicated launch executor (2 workers: one engine launch + one warm
@@ -638,7 +654,7 @@ class JaxWorkBackend(WorkBackend):
         """
         return max(math.exp(-span * cls._solve_p(difficulty)), 1e-12)
 
-    def _dispatch_next(self) -> "Optional[_Launch]":
+    def _dispatch_next(self, inflight: int = 0) -> "Optional[_Launch]":
         """Pack and submit one launch for the next difficulty rung, or None
         when nothing is worth dispatching.
 
@@ -683,9 +699,28 @@ class JaxWorkBackend(WorkBackend):
         # Reaching the floor pass means all demand is covered: anything
         # dispatched now is pure speculation.
         speculative = cutoff == SPEC_MISS_FLOOR
-        steps_want = self._next_rung(cands)
+        rung_key = self._next_rung(cands)
+        steps_want = rung_key
+        # Full width is only ever needed at the HEAD of the device queue:
+        # that launch's width is what makes a fresh hard request solve in a
+        # single round trip. Everything dispatched behind it — a pipelined
+        # successor (``inflight`` > 0), a speculative re-scan, or any launch
+        # while another rung has live jobs — executes after queued device
+        # time anyway, so its width buys no latency; it only parks more scan
+        # in front of fresh arrivals, cancels, and the other rung's next
+        # pass. Cap those at shared_steps_cap windows: the pipeline hides
+        # the extra per-launch dispatch overhead, so sustained throughput is
+        # unchanged, while worst-case wait-behind drops from
+        # pipeline*run_steps windows to ~run_steps + shared_steps_cap. The
+        # rung's identity (cursor slot, job pool) keeps the UNCAPPED key.
+        if (
+            speculative or inflight > 0 or len(rungs) > 1
+        ) and steps_want > self.shared_steps_cap:
+            steps_want = max(
+                s for s in self._step_counts() if s <= self.shared_steps_cap
+            )
         # Least-covered first (ties keep insertion order: oldest job wins).
-        pool = sorted(cands[steps_want], key=lambda j: -j.inflight_miss)
+        pool = sorted(cands[rung_key], key=lambda j: -j.inflight_miss)
         if speculative:
             # Bound the expected wasted device time (see SPEC_WASTE_ROWS).
             active, waste = [], 0.0
@@ -785,7 +820,7 @@ class JaxWorkBackend(WorkBackend):
             # Keep up to ``pipeline`` launches in flight: the device starts
             # on launch N+1 while launch N's results are still in transit.
             while len(inflight) < self.pipeline:
-                rec = self._dispatch_next()
+                rec = self._dispatch_next(len(inflight))
                 if rec is None:
                     break
                 inflight.append(rec)

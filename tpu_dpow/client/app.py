@@ -57,6 +57,8 @@ class DpowClient:
                 if config.pipeline > 0:
                     kwargs["pipeline"] = config.pipeline
                 kwargs["step_ladder"] = config.step_ladder
+                if config.shared_steps_cap > 0:
+                    kwargs["shared_steps_cap"] = config.shared_steps_cap
             backend = get_backend(config.backend, **kwargs)
         # The handler's in-flight cap must exceed the engine's batch size or
         # the batched launch can never fill (the queue would starve it at 8

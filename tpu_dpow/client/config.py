@@ -25,6 +25,7 @@ class ClientConfig:
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
     pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
     step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
+    shared_steps_cap: int = 0  # 0 = auto (run_steps/4); windows/launch under contention
     work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
     client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
     log_file: Optional[str] = None
@@ -38,6 +39,8 @@ class ClientConfig:
             raise ValueError("--run_steps must be >= 0 (0 = auto)")
         if self.pipeline < 0:
             raise ValueError("--pipeline must be >= 0 (0 = auto)")
+        if self.shared_steps_cap < 0:
+            raise ValueError("--shared_steps_cap must be >= 0 (0 = auto)")
         if self.payout_address:
             self.payout_address = self.payout_address.replace("xrb_", "nano_")
             nc.validate_account(self.payout_address)
@@ -76,6 +79,11 @@ def parse_args(argv=None) -> ClientConfig:
                    help="run-length quantization ladder (backend=jax): x2 halves "
                    "the window quantum for easy difficulties at ~2x the warmup "
                    "compiles")
+    p.add_argument("--shared_steps_cap", type=int, default=c.shared_steps_cap,
+                   help="max windows per launch when another difficulty rung "
+                   "has demand or the launch is speculative (backend=jax; "
+                   "0 = auto: run_steps/4 — bounds how long queued work and "
+                   "cancels wait behind one launch)")
     p.add_argument("--work_concurrency", type=int, default=c.work_concurrency,
                    help="work items in flight at once (0 = auto: 2*max_batch "
                    "for the jax backend, 8 otherwise)")
