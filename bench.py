@@ -63,9 +63,9 @@ def measure(reps: int = 8) -> dict:
         # retry attempts (and future rounds on this machine) then skip the
         # cold-compile window entirely. Best-effort — harmless where the
         # backend cannot serialize executables.
-        from tpu_dpow.utils import enable_compilation_cache
+        from tpu_dpow.utils import default_compilation_cache_dir, enable_compilation_cache
 
-        enable_compilation_cache("/tmp/tpu_dpow_jax_cache")
+        enable_compilation_cache(default_compilation_cache_dir())
     except Exception:
         pass
 

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Tunnel watcher: probe until the TPU tunnel is live, then capture evidence.
+#
+# The axon tunnel dies for hours at a time and a live window can be short
+# (~30 min observed), so the capture must fire the moment a probe succeeds —
+# not when a human notices. Run this detached at session start:
+#
+#   setsid nohup benchmarks/watch_and_capture.sh r4 < /dev/null \
+#       >> /tmp/tpu_watch.log 2>&1 &
+#
+# On the first live window it runs the priority-ordered evidence capture
+# (benchmarks/capture_evidence.py writes BENCH_latency.json progressively,
+# so a tunnel dying mid-capture still leaves the top-priority numbers), then
+# re-runs bench.py from a cold process to prove the persistent compile cache
+# (tpu_dpow.utils.default_compilation_cache_dir) makes a driver-slot
+# invocation fast, and exits. Probe details:
+#
+#   * the probe is a BOUNDED subprocess (an outage blocks the first jit
+#     indefinitely — even JAX_PLATFORMS=cpu blocks, because the axon plugin
+#     registration itself touches the tunnel);
+#   * the probe insists on a non-cpu device: a jax that silently resolved
+#     to CPU must not trigger a "TPU" capture.
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+# The axon TPU plugin registers via a sitecustomize hook that only fires
+# with its dir on PYTHONPATH — a detached environment without it would make
+# every probe see CPU-only jax and loop "tunnel down" through a live window.
+if [ -d /root/.axon_site ]; then
+    case ":${PYTHONPATH:-}:" in
+        *:/root/.axon_site:*) ;;
+        *) export PYTHONPATH="/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}" ;;
+    esac
+fi
+MARK="${1:-capture}"
+STEPS="${CAPTURE_STEPS:-headline,tests_tpu,latency_base,latency_base_x2ladder,flood,batch,fairness,cancel,overhead,latency_8x,soak}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"
+PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
+cd "$REPO"
+
+probe() {
+    timeout "$PROBE_TIMEOUT" python - <<'EOF'
+import jax
+jax.jit(lambda a: a + 1)(jax.numpy.ones((8,))).block_until_ready()
+raise SystemExit(0 if jax.devices()[0].platform != "cpu" else 1)
+EOF
+}
+
+while true; do
+    if probe; then
+        echo "$(date -u +%FT%TZ) tunnel LIVE -> capturing (mark=$MARK steps=$STEPS)"
+        python benchmarks/capture_evidence.py --steps "$STEPS" --mark "$MARK"
+        echo "$(date -u +%FT%TZ) capture done; timing a cold-process bench.py (compile-cache proof)"
+        start=$(date +%s)
+        python bench.py
+        echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+        echo "$(date -u +%FT%TZ) watcher done"
+        exit 0
+    fi
+    echo "$(date -u +%FT%TZ) tunnel down; retry in ${PROBE_INTERVAL}s"
+    sleep "$PROBE_INTERVAL"
+done

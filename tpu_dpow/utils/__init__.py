@@ -35,6 +35,35 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", value)
 
 
+def default_compilation_cache_dir() -> str:
+    """Per-user persistent compile-cache path shared by bench.py and the
+    tunnel watcher.
+
+    Lives under ``~/.cache`` (not /tmp): a world-writable /tmp lets any
+    local user pre-create the name and seed it — and a poisoned cache is
+    deserialized executable code. Belt-and-braces, the dir is created 0700
+    and verified owned-by-us and not group/other-writable; on any mismatch
+    (or no home) a fresh private tempdir is used instead — losing
+    persistence, never loading someone else's executables."""
+    import os
+    import stat
+    import tempfile
+
+    path = os.path.join(
+        os.path.expanduser("~"), ".cache", "tpu_dpow", "jax_cache"
+    )
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.stat(path)
+        if stat.S_ISDIR(st.st_mode) and (
+            not hasattr(os, "getuid") or st.st_uid == os.getuid()
+        ) and not st.st_mode & (stat.S_IWGRP | stat.S_IWOTH):
+            return path
+    except OSError:
+        pass
+    return tempfile.mkdtemp(prefix="tpu_dpow_jax_cache_")
+
+
 def enable_compilation_cache(path: str, *, min_compile_secs: float = 1.0) -> None:
     """Point JAX's persistent compilation cache at ``path``.
 
