@@ -49,6 +49,8 @@ STEPS = [
     ("batch", [sys.executable, "benchmarks/batch.py"], 600),
     ("soak", [sys.executable, "benchmarks/soak.py", "--waves", "10",
               "--width", "16"], 600),
+    ("chaos_crossproc", [sys.executable, "benchmarks/chaos_crossproc.py",
+                         "--n", "80", "--concurrency", "10"], 600),
 ]
 
 

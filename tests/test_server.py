@@ -726,6 +726,36 @@ def test_raise_landing_mid_dispatch_is_not_clobbered():
     run(main())
 
 
+def test_pending_work_republished_until_solved():
+    """work/ondemand rides QoS 0: a publish that fires while every worker
+    is dead (or mid-reconnect) is gone, and the reference strands the
+    waiter until timeout. The re-publish loop must re-announce a
+    still-unresolved hash so a worker that (re)appears picks it up — and
+    the original waiter succeeds with no client-side retry."""
+
+    async def main():
+        async with Harness(work_republish_interval=0.2) as hx:
+            h = random_hash()
+            # no workers yet: the first publish evaporates
+            task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, timeout=10))
+            )
+            # a late-joining worker sees the RE-published work message
+            await asyncio.sleep(0.1)
+            await hx.start_worker()
+            resp = await asyncio.wait_for(task, 10)
+            nc.validate_work(h, resp["work"], EASY_BASE)
+            msgs = [m for m in hx.worker_log if m.topic == "work/ondemand"]
+            assert msgs, "re-publish never reached the late worker"
+            # and the loop stops once the future resolves: no further
+            # publishes for this hash accumulate
+            await asyncio.sleep(0.5)
+            after = [m for m in hx.worker_log if m.topic == "work/ondemand"]
+            assert len(after) <= len(msgs) + 1  # at most one in-flight straggler
+
+    run(main())
+
+
 def test_raised_request_noop_when_inflight_already_stronger():
     """The inverse ordering: a BASE request joining a dispatch already
     published at a higher difficulty needs no re-target (the strong work

@@ -72,6 +72,10 @@ class DpowServer:
         # difficulty it was published). Entries live and die with the
         # work_futures entry for the same hash.
         self._dispatched_difficulty: Dict[str, int] = {}
+        # When each in-flight hash was last published to work/ondemand —
+        # the re-publish loop heals publishes lost to dead/reconnecting
+        # workers (work rides QoS 0). Entries live and die with work_futures.
+        self._last_publish: Dict[str, float] = {}
         # Per-hash: serializes the dispatcher's difficulty-entry write with
         # concurrent raisers for the SAME hash, so interleaved store writes
         # cannot leave `block-difficulty:` below what was last published.
@@ -108,6 +112,8 @@ class DpowServer:
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._statistics_loop()),
         ]
+        if self.config.work_republish_interval > 0:
+            self._tasks.append(asyncio.ensure_future(self._work_republish_loop()))
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
 
@@ -153,6 +159,56 @@ class DpowServer:
                 await self.transport.publish("statistics", json.dumps(stats), qos=QOS_0)
             except Exception as e:
                 logger.warning("statistics publish failed: %s", e)
+
+    async def _work_republish_loop(self) -> None:
+        """Heal lost work publishes for still-unresolved dispatches.
+
+        work/ondemand rides QoS 0 by design (a stale duplicate delivered
+        minutes later would waste lanes), so a publish that fired while
+        every worker was dead or mid-reconnect is simply gone — the
+        reference strands those waiters until timeout and expects the
+        service to retry (its dpow_server.py has no analog). Here any hash
+        still carrying an unresolved future `work_republish_interval` after
+        its last publish is re-published at its current (possibly raised)
+        target; workers already scanning it dedup the repeat on enqueue
+        (client/work_handler.py queue_work), so the heal costs nothing in
+        the healthy case.
+        """
+        interval = self.config.work_republish_interval
+        while True:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            for block_hash, fut in list(self.work_futures.items()):
+                last = self._last_publish.get(block_hash)
+                if last is None:
+                    # No recorded publish = the dispatcher is still mid-
+                    # dispatch (it stamps only after its lock-protected
+                    # publish). Publishing here would jump its difficulty-
+                    # entry serialization — it will publish momentarily.
+                    continue
+                if now - last < interval:
+                    continue
+                # Earlier iterations' awaits may have let this hash resolve
+                # or tear down; a stale publish would set workers grinding
+                # work nobody waits for, with no cancel fan-out behind it.
+                if self.work_futures.get(block_hash) is not fut or fut.done():
+                    continue
+                difficulty = self._dispatched_difficulty.get(
+                    block_hash, self.config.base_difficulty
+                )
+                try:
+                    await self.transport.publish(
+                        "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
+                    )
+                    logger.info("re-published pending work for %s", block_hash)
+                except Exception as e:
+                    logger.warning("work re-publish failed: %s", e)
+                    continue
+                # Re-stamp only while the entry is still live — the waiter
+                # teardown popping during our publish await must win, or
+                # every hash that races a republish tick leaks an entry.
+                if self.work_futures.get(block_hash) is fut:
+                    self._last_publish[block_hash] = time.monotonic()
 
     async def _checkpoint_loop(self) -> None:
         while True:
@@ -530,6 +586,7 @@ class DpowServer:
                     await self.transport.publish(
                         "work/ondemand", f"{block_hash},{effective:016x}", qos=QOS_0
                     )
+                    self._last_publish[block_hash] = time.monotonic()
             except BaseException:
                 # A failed dispatch must not leave a never-resolved future
                 # that later requests for this hash would silently wait on.
@@ -541,6 +598,7 @@ class DpowServer:
                     del self.work_futures[block_hash]
                     self._dispatched_difficulty.pop(block_hash, None)
                     self._difficulty_locks.pop(block_hash, None)
+                    self._last_publish.pop(block_hash, None)
                 if not created.done():
                     created.cancel()
                 raise
@@ -603,6 +661,7 @@ class DpowServer:
                         except BaseException:
                             self._dispatched_difficulty[block_hash] = current
                             raise
+                        self._last_publish[block_hash] = time.monotonic()
                         logger.info(
                             "re-targeted in-flight %s to %016x", block_hash, difficulty
                         )
@@ -630,6 +689,7 @@ class DpowServer:
                     del self.work_futures[block_hash]
                     self._dispatched_difficulty.pop(block_hash, None)
                     self._difficulty_locks.pop(block_hash, None)
+                    self._last_publish.pop(block_hash, None)
                 if not fut.done():
                     fut.cancel()
             else:

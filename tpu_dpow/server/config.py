@@ -49,6 +49,14 @@ class ServerConfig:
     max_timeout: float = 30.0
     heartbeat_interval: float = 1.0
     statistics_interval: float = 300.0
+    # Re-publish work/ondemand for hashes whose future is still unresolved
+    # after this long (0 disables). work messages ride QoS 0: a worker that
+    # died mid-scan, or a publish that fired into a broker with zero live
+    # work subscribers (all workers mid-reconnect), silently strands every
+    # waiter until timeout. The reference accepts that loss (services must
+    # retry); here the orchestrator heals it — client-side enqueue dedup
+    # makes the repeat publish free for workers already on the job.
+    work_republish_interval: float = 2.0
     log_file: Optional[str] = None
 
 
@@ -74,6 +82,11 @@ def parse_args(argv=None) -> ServerConfig:
     p.add_argument("--account_expiry", type=float, default=c.account_expiry)
     p.add_argument("--max_multiplier", type=float, default=c.max_multiplier)
     p.add_argument("--throttle", type=float, default=c.throttle)
+    p.add_argument("--work_republish_interval", type=float,
+                   default=c.work_republish_interval,
+                   help="re-publish work for still-unsolved dispatches after "
+                   "this many seconds (0 disables) — heals QoS-0 work "
+                   "messages lost to dead or reconnecting workers")
     p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
                    help="seconds between public statistics broadcasts "
                    "(reference: fixed 300)")
