@@ -136,6 +136,7 @@ def run(reps: int) -> None:
 
     async def engine():
         b = JaxWorkBackend(run_steps=16)
+        b.record_timeline = True
         await b.setup()
         times = []
         for _ in range(solves):
@@ -143,16 +144,49 @@ def run(reps: int) -> None:
             t0 = time.perf_counter()
             await b.generate(WorkRequest(h, difficulty))
             times.append(time.perf_counter() - t0)
+        timeline = list(b.timeline)
         await b.close()
-        return times
+        return times, timeline
 
-    etimes = asyncio.run(engine())
+    etimes, timeline = asyncio.run(engine())
     out["engine_solve_p50_ms"] = round(
         float(np.percentile(etimes, 50)) * 1e3, 2
     )
     out["engine_overhead_p50_ms"] = round(
         (np.percentile(etimes, 50) - np.percentile(ktimes, 50)) * 1e3, 2
     )
+
+    # Stage decomposition of the engine path (names each overhead ms):
+    #   queue_wait   — generate() → first dispatch carrying the job (engine
+    #                  pass scheduling + waiting on a pipeline slot)
+    #   exec_queue   — dispatch → launch thread starts (executor hop)
+    #   device       — launch thread: transfer + device scan + readback
+    #   apply_hop    — readback done → engine loop applies results
+    launches = [t for kind, t in timeline if kind == "launch"
+                and "t_apply" in t and "t_thread" in t]
+    solves_t = [t for kind, t in timeline if kind == "solve"]
+    if launches:
+        out["stage_exec_queue_p50_ms"] = round(float(np.percentile(
+            [(t["t_thread"] - t["t_dispatch"]) * 1e3 for t in launches], 50)), 2)
+        out["stage_device_p50_ms"] = round(float(np.percentile(
+            [(t["t_done"] - t["t_thread"]) * 1e3 for t in launches], 50)), 2)
+        out["stage_apply_hop_p50_ms"] = round(float(np.percentile(
+            [(t["t_apply"] - t["t_done"]) * 1e3 for t in launches], 50)), 2)
+        # Head launches (nothing in flight) vs successors: prices how much
+        # device time a fresh dispatch spends queued behind residue.
+        head_dev = [(t["t_done"] - t["t_thread"]) * 1e3
+                    for t in launches if t.get("inflight", 0) == 0]
+        succ_dev = [(t["t_done"] - t["t_thread"]) * 1e3
+                    for t in launches if t.get("inflight", 0) > 0]
+        if head_dev:
+            out["stage_device_head_p50_ms"] = round(
+                float(np.percentile(head_dev, 50)), 2)
+        if succ_dev:
+            out["stage_device_successor_p50_ms"] = round(
+                float(np.percentile(succ_dev, 50)), 2)
+    if solves_t:
+        out["stage_queue_wait_p50_ms"] = round(float(np.percentile(
+            [t["queue_wait"] * 1e3 for t in solves_t], 50)), 2)
     print(json.dumps(out))
 
 

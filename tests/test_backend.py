@@ -883,6 +883,42 @@ def test_speculative_successor_launch_is_narrow():
     asyncio.run(asyncio.wait_for(run(), 30))
 
 
+def test_timeline_records_launch_stages_and_solves():
+    """record_timeline must stamp every launch's stage boundaries (the
+    overhead decomposition in benchmarks/overhead.py reads them) and one
+    solve record per resolved job — and stay empty when off (the default:
+    no per-launch cost for production)."""
+
+    async def run():
+        b = make_backend()
+        b.record_timeline = True
+        await b.setup()
+        works = await asyncio.gather(
+            *(b.generate(WorkRequest(random_hash(), EASY)) for _ in range(3))
+        )
+        assert all(works)
+        tl = list(b.timeline)
+        await b.close()
+        launches = [t for k, t in tl if k == "launch"]
+        solves = [t for k, t in tl if k == "solve"]
+        assert launches and len(solves) == 3
+        for t in launches:
+            assert (
+                t["t_dispatch"] <= t["t_thread"] <= t["t_done"] <= t["t_apply"]
+            ), t
+            assert t["batch"] >= 1 and t["steps"] >= 1 and t["inflight"] >= 0
+        for s in solves:
+            assert 0 <= s["queue_wait"] <= s["total"]
+
+        b2 = make_backend()
+        await b2.setup()
+        await b2.generate(WorkRequest(random_hash(), EASY))
+        assert not list(b2.timeline)  # off by default
+        await b2.close()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
 def test_step_ladder_options():
     """x2 ladder halves the run-length quantum; x4 stays the default."""
     b4 = make_backend(run_steps=16)

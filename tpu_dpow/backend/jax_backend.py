@@ -49,6 +49,8 @@ from __future__ import annotations
 import asyncio
 import math
 import secrets
+import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -95,6 +97,9 @@ class _Job:
     waiters: int = 0  # refcount: last cancelled waiter drops the job
     # P(no launch currently in flight solves this job); 1.0 = uncovered.
     inflight_miss: float = 1.0
+    # Timeline stamps (record_timeline only): submission and first dispatch.
+    t_submit: float = 0.0
+    t_first_dispatch: float = 0.0
 
     def set_base(self, base: int) -> None:
         self.base = base & _MASK64
@@ -124,6 +129,7 @@ class _Launch:
     span: int  # nonces scanned per row this launch
     shape: tuple  # (batch, steps) — warmed on success
     miss_factors: list  # per-job P(this span misses), undone when applied
+    timing: "Optional[dict]" = None  # stage stamps when record_timeline is on
 
 
 class JaxWorkBackend(WorkBackend):
@@ -274,6 +280,13 @@ class JaxWorkBackend(WorkBackend):
         self._closed = False
         self.total_hashes = 0
         self.total_solutions = 0
+        # Per-stage latency decomposition (benchmarks/overhead.py): when on,
+        # every launch appends {t_dispatch, t_thread, t_done, t_apply,
+        # batch, steps} and every solve appends {queue_wait, total} to
+        # ``timeline``. Off by default — stamps cost a few perf_counter()
+        # calls per launch, nothing on the device path.
+        self.record_timeline = False
+        self.timeline: "deque[tuple]" = deque(maxlen=1024)
 
     # -- WorkBackend interface -------------------------------------------
 
@@ -325,6 +338,7 @@ class JaxWorkBackend(WorkBackend):
             params=search.pack_params(request.hash_bytes, request.difficulty, 0),
             future=asyncio.get_running_loop().create_future(),
             base=0,
+            t_submit=time.perf_counter() if self.record_timeline else 0.0,
         )
         job.set_base(secrets.randbits(64))
         self._jobs[key] = job
@@ -508,7 +522,9 @@ class JaxWorkBackend(WorkBackend):
                 return steps
         return self.run_steps
 
-    def _submit_launch(self, params_batch: np.ndarray, steps: int) -> asyncio.Future:
+    def _submit_launch(
+        self, params_batch: np.ndarray, steps: int, timing: Optional[dict] = None
+    ) -> asyncio.Future:
         """Hand a launch to the executor; device work starts immediately."""
         if self._executor is None:
             import concurrent.futures
@@ -518,7 +534,16 @@ class JaxWorkBackend(WorkBackend):
                 max_workers=self.pipeline + 1
             )
         loop = asyncio.get_running_loop()
-        return loop.run_in_executor(self._executor, self._launch, params_batch, steps)
+        if timing is None:
+            return loop.run_in_executor(self._executor, self._launch, params_batch, steps)
+
+        def timed():  # stamps the executor-queue and device stages
+            timing["t_thread"] = time.perf_counter()
+            out = self._launch(params_batch, steps)
+            timing["t_done"] = time.perf_counter()
+            return out
+
+        return loop.run_in_executor(self._executor, timed)
 
     async def _await_launch(self, fut: asyncio.Future, shape_note: str) -> tuple:
         if self.launch_timeout is None:
@@ -738,8 +763,14 @@ class JaxWorkBackend(WorkBackend):
         params = self._pack(active, b)
         span = self.chunk * steps
         factors = [self._miss_factor(j.difficulty, span) for j in active]
+        timing = None
+        if self.record_timeline:
+            timing = {"t_dispatch": time.perf_counter(), "inflight": inflight}
+            for j in active:
+                if not j.t_first_dispatch:
+                    j.t_first_dispatch = timing["t_dispatch"]
         rec = _Launch(
-            fut=self._submit_launch(params, steps),
+            fut=self._submit_launch(params, steps, timing),
             jobs=active,
             # Snapshot targets and bases at launch: a concurrent dedup may
             # raise job.difficulty, and a pipelined successor dispatch will
@@ -749,6 +780,7 @@ class JaxWorkBackend(WorkBackend):
             span=span,
             shape=(params.shape[0], steps),
             miss_factors=factors,
+            timing=timing,
         )
         for job, f in zip(active, factors):
             job.set_base(job.base + span)
@@ -757,6 +789,10 @@ class JaxWorkBackend(WorkBackend):
 
     def _apply_results(self, rec: "_Launch", lo_arr, hi_arr) -> None:
         self._warm.add(rec.shape)  # organic warming
+        if rec.timing is not None:
+            rec.timing["t_apply"] = time.perf_counter()
+            rec.timing["batch"], rec.timing["steps"] = rec.shape
+            self.timeline.append(("launch", rec.timing))
         for job, f in zip(rec.jobs, rec.miss_factors):
             # This launch is no longer in flight: undo its coverage factor
             # (clamped — repeated multiply/divide may drift past 1.0).
@@ -780,6 +816,15 @@ class JaxWorkBackend(WorkBackend):
             if value >= job.difficulty:
                 self.total_solutions += 1
                 job.future.set_result(work)
+                if rec.timing is not None and job.t_submit:
+                    now = time.perf_counter()
+                    self.timeline.append((
+                        "solve",
+                        {
+                            "queue_wait": job.t_first_dispatch - job.t_submit,
+                            "total": now - job.t_submit,
+                        },
+                    ))
             elif value >= launched:
                 # Valid for the difficulty this chunk was launched at,
                 # but the target was raised mid-flight: keep searching
@@ -796,8 +841,6 @@ class JaxWorkBackend(WorkBackend):
                 )
 
     async def _engine_loop_inner(self) -> None:
-        from collections import deque
-
         inflight: deque = deque()
         while not self._closed:
             if not inflight:
