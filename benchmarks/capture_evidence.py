@@ -45,6 +45,7 @@ STEPS = [
                "--concurrency", "20"], 900),
     ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
     ("cancel", [sys.executable, "benchmarks/cancel_latency.py", "--n", "10"], 600),
+    ("gang_ab", [sys.executable, "benchmarks/gang_ab.py", "--reps", "20"], 600),
     ("overhead", [sys.executable, "benchmarks/overhead.py"], 900),
     ("batch", [sys.executable, "benchmarks/batch.py"], 600),
     ("soak", [sys.executable, "benchmarks/soak.py", "--waves", "10",

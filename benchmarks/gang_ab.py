@@ -1,0 +1,102 @@
+"""One-chip A/B: the ganged (shard_map/pmin) path vs the plain path.
+
+The 8-chip <50 ms projection (BASELINE.md) needs the ganged machinery's
+cost measured on real hardware, not assumed. A mesh of ONE device runs the
+exact shard_map + replicate_params + pmin-election code of the flagship
+gang (parallel/mesh_search.py) with zero actual ICI traffic — so
+
+    p50(mesh_devices=1) - p50(plain)
+
+prices the gang's dispatch-side machinery at real geometry on the real
+chip. Combined with benchmarks/multichip.py --sweep (how the machinery
+SCALES with gang size, measured on a virtual mesh), the projection's
+"~2 ms ICI/dispatch" assumption becomes two measured components plus only
+the physical ICI hop as the remaining estimate.
+
+Both sides run the SAME engine, difficulty, and geometry; kernel launches
+differ only in the mesh. Uses direct kernel-path launches (not the full
+backend) so the A/B isolates the launch machinery from engine scheduling.
+
+Usage: python benchmarks/gang_ab.py [--reps 20]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from tpu_dpow.ops import pallas_kernel, search
+
+SUBLANES, ITERS, NBLOCKS, GROUP = 32, 1024, 8, 8
+
+
+def run(reps: int) -> None:
+    import jax
+
+    from tpu_dpow.parallel import (
+        make_mesh,
+        replicate_params,
+        sharded_search_chunk_batch,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    sublanes, iters, nblocks, group = (
+        (SUBLANES, ITERS, NBLOCKS, GROUP) if on_tpu else (8, 8, 1, 1)
+    )
+    kernel = "pallas" if on_tpu else "xla"
+    chunk = sublanes * 128 * iters * nblocks
+    rows = np.stack([search.pack_params(bytes(32), (1 << 64) - 1, 0)])
+
+    # plain path: single-device kernel launch
+    pj = jax.device_put(rows, dev)
+
+    def plain():
+        if kernel == "pallas":
+            return pallas_kernel.pallas_search_chunk_batch(
+                pj, sublanes=sublanes, iters=iters, nblocks=nblocks, group=group
+            )
+        return search.search_chunk_batch(pj, chunk_size=chunk)
+
+    # ganged path, gang size ONE: same shard_map/pmin code, no ICI traffic
+    mesh = make_mesh([dev])
+    params = replicate_params(rows, mesh)
+
+    def ganged():
+        return sharded_search_chunk_batch(
+            params, mesh=mesh, chunk_per_shard=chunk, kernel=kernel,
+            sublanes=sublanes, iters=iters, nblocks=nblocks, group=group,
+        )
+
+    results = {}
+    for name, fn in (("plain", plain), ("ganged_1", ganged)):
+        np.asarray(fn())  # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn())
+            times.append(time.perf_counter() - t0)
+        results[name] = times
+
+    p50 = {k: float(np.percentile(v, 50)) * 1e3 for k, v in results.items()}
+    print(json.dumps({
+        "bench": "gang_machinery_ab",
+        "platform": dev.platform,
+        "reps": reps,
+        "chunk": chunk,
+        "plain_p50_ms": round(p50["plain"], 3),
+        "ganged1_p50_ms": round(p50["ganged_1"], 3),
+        "machinery_ms": round(p50["ganged_1"] - p50["plain"], 3),
+    }))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--reps", type=int, default=20)
+    args = p.parse_args()
+    run(args.reps)

@@ -106,11 +106,92 @@ def run(n_devices: int, batch_shards: int, chunk_per_shard: int, reps: int) -> N
     )
 
 
+def sweep(max_devices: int, reps: int) -> None:
+    """Overhead SCALING for the 8-chip latency projection (BASELINE.md).
+
+    The ganged p50 estimate carries a "~2 ms ICI/dispatch" assumption with
+    zero measured components behind it. This prices the two structural
+    terms the projection needs, as functions of gang size and run length:
+
+      * ``launch_overhead_ms[n]`` — one ganged dispatch at a NEGLIGIBLE
+        per-shard chunk, so the measurement is the dispatch + shard_map +
+        pmin-collective machinery, not scan;
+      * ``per_window_overhead_ms[steps]`` — the device-resident loop at the
+        same tiny chunk across run lengths; the marginal ms per extra
+        window is the loop + per-window collective cost.
+
+    Absolute numbers on virtual CPU devices are not TPU numbers; the SHAPE
+    (how overhead grows with n and steps) is the structural part of the
+    projection, and the one-chip A/B (benchmarks/gang_ab.py) anchors the
+    absolute scale on real hardware.
+    """
+    import jax
+
+    from tpu_dpow.ops import search
+    from tpu_dpow.parallel import (
+        make_mesh,
+        replicate_params,
+        sharded_search_chunk_batch,
+        sharded_search_run,
+    )
+
+    devices = jax.devices()
+    chunk = 1024  # scan is noise at this size; machinery dominates
+    out = {
+        "bench": "multichip_overhead_sweep",
+        "platform": devices[0].platform,
+        "chunk_per_shard": chunk,
+        "reps": reps,
+        "launch_overhead_ms": {},
+        "per_window_overhead_ms": {},
+    }
+
+    rows = np.stack([search.pack_params(bytes(32), (1 << 64) - 1, 0)])
+
+    n = 1
+    while n <= min(max_devices, len(devices)):
+        mesh = make_mesh(devices[:n])
+        params = replicate_params(rows, mesh)
+        np.asarray(sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=chunk))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            got = sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=chunk)
+        np.asarray(got)
+        out["launch_overhead_ms"][n] = round((time.perf_counter() - t0) / reps * 1e3, 3)
+        n *= 2
+
+    n_full = min(max_devices, len(devices))
+    mesh = make_mesh(devices[:n_full])
+    params = replicate_params(rows, mesh)
+    for steps in (1, 2, 4, 8, 16):
+        np.asarray(sharded_search_run(
+            params, mesh=mesh, chunk_per_shard=chunk, max_steps=steps)[0])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            lo, _ = sharded_search_run(
+                params, mesh=mesh, chunk_per_shard=chunk, max_steps=steps
+            )
+            np.asarray(lo)
+        out["per_window_overhead_ms"][steps] = round(
+            (time.perf_counter() - t0) / reps * 1e3, 3
+        )
+    # marginal per-window cost from the largest span of the sweep
+    w = out["per_window_overhead_ms"]
+    out["marginal_ms_per_window"] = round((w[16] - w[1]) / 15, 4)
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--devices", type=int, default=8)
     p.add_argument("--batch-shards", type=int, default=1)
     p.add_argument("--chunk-per-shard", type=int, default=65536)
     p.add_argument("--reps", type=int, default=8)
+    p.add_argument("--sweep", action="store_true",
+                   help="overhead-scaling sweep over gang sizes and run "
+                   "lengths (the 8-chip projection's measured components)")
     args = p.parse_args()
-    run(args.devices, args.batch_shards, args.chunk_per_shard, args.reps)
+    if args.sweep:
+        sweep(args.devices, args.reps)
+    else:
+        run(args.devices, args.batch_shards, args.chunk_per_shard, args.reps)
