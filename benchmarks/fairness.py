@@ -3,12 +3,18 @@
 The engine groups jobs into difficulty rungs served round-robin
 (tpu_dpow/backend/jax_backend.py _next_rung), so a steady stream of
 steps-1 precache work must not starve — nor be starved by — one wide 8x
-on-demand request. This measures exactly that adversarial mix: a sustained
-base-difficulty flood, then one 8x request timed against its OWN solo
-baseline. The gap between mixed and solo latency is the scheduling tax;
-round-robin bounds it near one easy-launch time per hard launch (the
-reference's one-POST-at-a-time worker serializes the whole queue instead,
-reference client/work_handler.py:98-108).
+on-demand request. This measures exactly that adversarial mix: a hard
+request timed through a sustained base-difficulty flood against its OWN
+solo baseline. The gap is the scheduling tax; round-robin + the
+shared_steps_cap successor narrowing bound it near one capped launch per
+hard launch (the reference's one-POST-at-a-time worker serializes the
+whole queue instead, reference client/work_handler.py:98-108).
+
+Solo and mixed trials are INTERLEAVED pair-by-pair, with an engine-drain
+gate before each solo trial: round 3's block design (all solo, then all
+mixed) measured the two halves in different session states — a drifting
+tunnel floor made the flood look 146 ms FASTER than idle, i.e. the design
+measured drift, not scheduling.
 
 Usage: python benchmarks/fairness.py [--n 10] [--flood 8] [--multiplier 8]
 """
@@ -50,34 +56,42 @@ async def run(n: int, flood_width: int, multiplier: float) -> None:
     await backend.setup()
     await _bootstrap.wait_for_warmup(backend)  # steady-state, not compile queueing
 
-    # Solo baseline: the 8x request with the engine to itself.
-    solo = [await timed_hard(backend, hard) for _ in range(n)]
+    async def drain() -> None:
+        # Solo trials need a genuinely idle engine: residual flood jobs
+        # (and their in-flight launches) from the previous mixed trial
+        # would contend with — and inflate — the solo measurement.
+        deadline = time.perf_counter() + 5.0
+        while backend._jobs and time.perf_counter() < deadline:
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)  # in-flight launches finish draining
 
-    # Sustained easy flood: keep `flood_width` base-difficulty requests in
-    # flight at all times (precache traffic shape), measure the same hard
-    # request through the contention.
-    stop = asyncio.Event()
+    solo, mixed = [], []
     flood_count = 0
+    for _ in range(n):
+        await drain()
+        solo.append(await timed_hard(backend, hard))
 
-    async def flooder():
-        nonlocal flood_count
-        while not stop.is_set():
-            h = RNG.bytes(32).hex().upper()
-            try:
-                work = await backend.generate(WorkRequest(h, base))
-                nc.validate_work(h, work, base)
-                flood_count += 1
-            except Exception:
-                if not stop.is_set():
-                    raise
+        stop = asyncio.Event()
 
-    floods = [asyncio.ensure_future(flooder()) for _ in range(flood_width)]
-    await asyncio.sleep(0.2)  # flood reaches steady state
-    mixed = [await timed_hard(backend, hard) for _ in range(n)]
-    stop.set()
-    for f in floods:
-        f.cancel()
-    await asyncio.gather(*floods, return_exceptions=True)
+        async def flooder():
+            nonlocal flood_count
+            while not stop.is_set():
+                h = RNG.bytes(32).hex().upper()
+                try:
+                    work = await backend.generate(WorkRequest(h, base))
+                    nc.validate_work(h, work, base)
+                    flood_count += 1
+                except Exception:
+                    if not stop.is_set():
+                        raise
+
+        floods = [asyncio.ensure_future(flooder()) for _ in range(flood_width)]
+        await asyncio.sleep(0.2)  # flood reaches steady state
+        mixed.append(await timed_hard(backend, hard))
+        stop.set()
+        for f in floods:
+            f.cancel()
+        await asyncio.gather(*floods, return_exceptions=True)
     await backend.close()
 
     solo_ms = np.asarray(sorted(solo)) * 1e3
