@@ -52,6 +52,11 @@ STEPS = [
               "--width", "16"], 600),
     ("chaos_crossproc", [sys.executable, "benchmarks/chaos_crossproc.py",
                          "--n", "80", "--concurrency", "10"], 600),
+    # Lowest priority: geometry re-sweep hunting a new champion shape —
+    # only the LAST JSON line (the sweep prints one per shape) is recorded,
+    # so the full stdout lands in the watch log, not BENCH_latency.json.
+    ("throughput_sweep", [sys.executable, "benchmarks/throughput.py",
+                          "--reps", "6"], 1200),
 ]
 
 

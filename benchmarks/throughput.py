@@ -40,10 +40,19 @@ def sweep_jax(reps: int) -> None:
             (32, 1024, nb, g) for nb in (8, 32, 64) for g in (1, 8)
         ] + [
             (64, 1024, 16, 8), (16, 1024, 128, 8),
+        ] + [
+            # r4 additions around the r3 champion (32,1024,64,8) @1.119 GH/s:
+            # rarer early-exit checks (the found-flag cond costs scalar-
+            # pipeline time every `group` tiles) and longer-iter shapes that
+            # halve the grid-step count at the same window.
+            (32, 1024, 64, 16), (32, 1024, 64, 32),
+            (32, 2048, 32, 8), (32, 2048, 32, 16),
+            (64, 512, 64, 8), (64, 2048, 16, 8),
         ]
     else:
         geometries = [(8, 8, 1, 1)]  # CPU smoke shape
 
+    best = None
     for sublanes, iters, nblocks, group in geometries:
         chunk = sublanes * 128 * iters * nblocks
 
@@ -61,21 +70,24 @@ def sweep_jax(reps: int) -> None:
             out = launch()
         np.asarray(out)
         dt = time.perf_counter() - t0
-        print(
-            json.dumps(
-                {
-                    "bench": "throughput_geometry",
-                    "platform": dev.platform,
-                    "sublanes": sublanes,
-                    "iters": iters,
-                    "nblocks": nblocks,
-                    "group": group,
-                    "chunk": chunk,
-                    "hs": round(reps * chunk / dt, 1),
-                    "launch_ms": round(dt / reps * 1e3, 3),
-                }
-            )
-        )
+        rec = {
+            "bench": "throughput_geometry",
+            "platform": dev.platform,
+            "sublanes": sublanes,
+            "iters": iters,
+            "nblocks": nblocks,
+            "group": group,
+            "chunk": chunk,
+            "hs": round(reps * chunk / dt, 1),
+            "launch_ms": round(dt / reps * 1e3, 3),
+        }
+        print(json.dumps(rec), flush=True)
+        if best is None or rec["hs"] > best["hs"]:
+            best = rec
+    # Final summary line: evidence-capture steps record the LAST JSON line,
+    # so the champion shape lands in BENCH_latency.json while the full grid
+    # stays in the step's stdout/watch log.
+    print(json.dumps({**best, "bench": "throughput_sweep_best"}))
 
 
 def sweep_native(reps: int) -> None:
