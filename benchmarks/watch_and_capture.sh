@@ -39,7 +39,11 @@ PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
 cd "$REPO"
 
 probe() {
-    timeout "$PROBE_TIMEOUT" python - <<'EOF'
+    # --kill-after: a probe wedged in an uninterruptible tunnel call can
+    # shrug off the TERM; without the KILL backstop one stuck probe parks
+    # the watcher forever (observed: a half-up tunnel ate the TERM and the
+    # watcher sat 6+ min past its own timeout).
+    timeout --kill-after=30 "$PROBE_TIMEOUT" python - <<'EOF'
 import jax
 jax.jit(lambda a: a + 1)(jax.numpy.ones((8,))).block_until_ready()
 raise SystemExit(0 if jax.devices()[0].platform != "cpu" else 1)
