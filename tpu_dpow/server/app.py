@@ -86,6 +86,7 @@ class DpowServer:
         self._difficulty_locks: Dict[str, asyncio.Lock] = {}
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
+        self.work_republished = 0  # healed lost publishes (observability)
         self._tasks: list = []
         self._started = False
 
@@ -200,6 +201,7 @@ class DpowServer:
                     await self.transport.publish(
                         "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
                     )
+                    self.work_republished += 1
                     logger.info("re-published pending work for %s", block_hash)
                 except Exception as e:
                     logger.warning("work re-publish failed: %s", e)
@@ -244,6 +246,10 @@ class DpowServer:
         return {
             "services": {"public": public_services, "private": private_services},
             "work": {"precache": precache_total, "ondemand": ondemand_total},
+            # Additive over the reference's payload shape: how often the
+            # orchestrator had to heal a lost work publish (republish loop).
+            # A climbing value means workers are flapping or absent.
+            "work_republished": self.work_republished,
         }
 
     # ------------------------------------------------------------------
