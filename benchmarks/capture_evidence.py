@@ -46,6 +46,8 @@ STEPS = [
     ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
     ("cancel", [sys.executable, "benchmarks/cancel_latency.py", "--n", "10"], 600),
     ("gang_ab", [sys.executable, "benchmarks/gang_ab.py", "--reps", "20"], 600),
+    ("latency_mesh1", [sys.executable, "benchmarks/latency.py", "--n", "15",
+                       "--mesh_devices", "1"], 900),
     ("overhead", [sys.executable, "benchmarks/overhead.py"], 900),
     ("batch", [sys.executable, "benchmarks/batch.py"], 600),
     ("soak", [sys.executable, "benchmarks/soak.py", "--waves", "10",

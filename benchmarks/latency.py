@@ -29,13 +29,26 @@ from tpu_dpow.utils import nanocrypto as nc
 RNG = np.random.default_rng(0xD0)
 
 
-async def run(n: int, difficulty: int, backend_name: str, step_ladder: str = "x4") -> None:
+async def run(
+    n: int,
+    difficulty: int,
+    backend_name: str,
+    step_ladder: str = "x4",
+    mesh_devices: int = 0,
+) -> None:
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if backend_name == "jax" and not on_tpu:
         difficulty = min(difficulty, 0xFFF0000000000000)  # keep CPU runs sane
     kwargs = {"step_ladder": step_ladder} if backend_name == "jax" else {}
+    if backend_name == "jax" and mesh_devices > 0:
+        # Full-backend A/B vs the plain path: mesh_devices=1 runs the exact
+        # ganged engine (shard_map launches, pmin election, replicated
+        # params) on one device — p50 minus the plain run's p50 prices the
+        # gang machinery at the ENGINE level, complementing the raw-kernel
+        # A/B in benchmarks/gang_ab.py.
+        kwargs["mesh_devices"] = mesh_devices
     backend = get_backend(backend_name, **kwargs)
     await backend.setup()
     # Steady-state measurement: round 3's first capture timed solves while
@@ -58,6 +71,7 @@ async def run(n: int, difficulty: int, backend_name: str, step_ladder: str = "x4
             {
                 "bench": "single_request_latency",
                 "backend": backend_name,
+                "mesh_devices": mesh_devices,
                 "platform": jax.devices()[0].platform,
                 "difficulty": f"{difficulty:016x}",
                 "n": n,
@@ -78,9 +92,12 @@ if __name__ == "__main__":
                    help="run-length quantization ladder A/B (backend=jax)")
     p.add_argument("--difficulty", default=None, help="hex override")
     p.add_argument("--backend", default="jax", choices=["jax", "native"])
+    p.add_argument("--mesh_devices", type=int, default=0,
+                   help="run the ganged engine at this gang size (0 = plain "
+                   "path; 1 = gang machinery A/B on one device)")
     args = p.parse_args()
     if args.difficulty:
         diff = int(args.difficulty, 16)
     else:
         diff = nc.derive_work_difficulty(args.multiplier)
-    asyncio.run(run(args.n, diff, args.backend, args.step_ladder))
+    asyncio.run(run(args.n, diff, args.backend, args.step_ladder, args.mesh_devices))
