@@ -756,6 +756,43 @@ def test_pending_work_republished_until_solved():
     run(main())
 
 
+def test_republish_carries_raised_target():
+    """A re-publish for a hash whose in-flight dispatch was re-targeted
+    must go out at the RAISED difficulty — re-announcing base would hand a
+    late-joining worker a target whose results the handler rejects."""
+
+    async def main():
+        async with Harness(work_republish_interval=0.2) as hx:
+            h = random_hash()
+            raised = nc.derive_work_difficulty(4.0, EASY_BASE)
+            base_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, timeout=10))
+            )
+            await asyncio.sleep(0.02)  # base dispatch publishes into the void
+            raised_task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, multiplier=4.0, timeout=10))
+            )
+            await asyncio.sleep(0.5)  # at least one republish tick elapses
+            t = await hx.start_worker()
+            await wait_until(
+                lambda: any(m.topic == "work/ondemand" for m in hx.worker_log)
+            )
+            # every re-announcement the late worker sees carries the raise
+            republished = [
+                m.payload for m in hx.worker_log if m.topic == "work/ondemand"
+            ]
+            assert republished and all(
+                p == f"{h},{raised:016x}" for p in republished
+            ), republished
+            strong = solve(h, raised)
+            await t.publish("result/ondemand", f"{h},{strong},{ACCOUNT}")
+            base_resp, raised_resp = await asyncio.gather(base_task, raised_task)
+            assert base_resp["work"] == strong and raised_resp["work"] == strong
+            assert hx.server.work_republished >= 1
+
+    run(main())
+
+
 def test_raised_request_noop_when_inflight_already_stronger():
     """The inverse ordering: a BASE request joining a dispatch already
     published at a higher difficulty needs no re-target (the strong work
