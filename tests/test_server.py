@@ -793,6 +793,33 @@ def test_republish_carries_raised_target():
     run(main())
 
 
+def test_republish_stops_when_frontier_retires_the_hash():
+    """A hash whose `block:` key was retired (frontier moved on) must not
+    keep being re-announced: the result handler drops all results for it,
+    so each re-publish would just burn worker lanes on a dead target."""
+
+    async def main():
+        async with Harness(work_republish_interval=0.15) as hx:
+            h = random_hash()
+            task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, timeout=2))
+            )
+            await asyncio.sleep(0.4)  # a republish tick or two with no workers
+            # frontier retirement deletes the work key mid-flight
+            await hx.store.delete(f"block:{h}")
+            await asyncio.sleep(0.1)
+            t = await hx.start_worker(respond=False)  # observe only
+            await asyncio.sleep(0.5)  # several would-be republish ticks
+            dead = [m for m in hx.worker_log if m.topic == "work/ondemand"]
+            assert dead == [], dead  # nothing re-announced a retired hash
+            from tpu_dpow.server import RetryRequest
+
+            with pytest.raises((RequestTimeout, RetryRequest)):
+                await task
+
+    run(main())
+
+
 def test_raised_request_noop_when_inflight_already_stronger():
     """The inverse ordering: a BASE request joining a dispatch already
     published at a higher difficulty needs no re-target (the strong work

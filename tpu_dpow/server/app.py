@@ -194,6 +194,15 @@ class DpowServer:
                 # work nobody waits for, with no cancel fan-out behind it.
                 if self.work_futures.get(block_hash) is not fut or fut.done():
                     continue
+                # Work no longer wanted at the store level — the frontier
+                # moved on (block_arrival retired the key) or a result
+                # already landed. The result handler drops everything for
+                # such a hash, so re-announcing it would have workers grind
+                # a dead target once per interval until the waiter times
+                # out. Let the waiter run out quietly instead.
+                avail = await self.store.get(f"block:{block_hash}")
+                if avail != WORK_PENDING:
+                    continue
                 difficulty = self._dispatched_difficulty.get(
                     block_hash, self.config.base_difficulty
                 )
