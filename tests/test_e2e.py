@@ -515,3 +515,41 @@ def test_e2e_mqtt_worker_drop_gets_cancel_on_reconnect():
             await tcp_server.stop()
 
     run(main())
+
+
+def test_e2e_late_worker_heals_stranded_request():
+    """The republish heal at full-stack level: a request POSTs while ZERO
+    workers are connected (its QoS-0 work publish fires into the void), a
+    worker joins afterwards, and the request completes off a re-publish —
+    no client-side retry, no error. The reference strands this request
+    until timeout."""
+
+    async def main():
+        broker = Broker()
+        runner, server, store, clients = await start_stack(
+            broker, n_clients=0, work_republish_interval=0.3
+        )
+        late = None
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                h = random_hash()
+                post = asyncio.ensure_future(http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h,
+                               "timeout": 15},
+                ))
+                await asyncio.sleep(0.5)  # original publish long gone
+                late = make_client(
+                    InProcTransport(broker, client_id="late-worker"), PAYOUT_1
+                )
+                await late.setup()
+                late.start_loops()
+                resp = await asyncio.wait_for(post, 20)
+                body = await resp.json()
+                assert "work" in body, body
+                nc.validate_work(h, body["work"], EASY_BASE)
+                assert server.work_republished >= 1
+        finally:
+            await stop_stack(runner, [late] if late else [])
+
+    run(main())
