@@ -25,14 +25,17 @@ EASY_BASE = 0xF000000000000000  # ~16 hashes expected
 ACCOUNT = nc.encode_account(bytes(range(32)))
 
 
-def solve(block_hash: str, difficulty: int, start: int = 0) -> str:
+def solve(block_hash: str, difficulty: int, start: int = 0, below: int = None) -> str:
+    """First nonce whose value meets ``difficulty`` — and, when ``below`` is
+    given, does NOT meet it (a deliberately weak solution for retarget
+    tests)."""
     h = bytes.fromhex(block_hash)
     w = start
     while True:
         v = int.from_bytes(
             hashlib.blake2b(struct.pack("<Q", w) + h, digest_size=8).digest(), "little"
         )
-        if v >= difficulty:
+        if v >= difficulty and (below is None or v < below):
             return f"{w:016x}"
         w += 1
 
@@ -585,20 +588,6 @@ def test_concurrent_base_and_raised_dispatch_single_future():
     run(main())
 
 
-def solve_between(block_hash: str, lo: int, hi: int) -> str:
-    """Work whose value meets ``lo`` but NOT ``hi`` (a deliberately weak
-    solution for retarget tests)."""
-    h = bytes.fromhex(block_hash)
-    w = 0
-    while True:
-        v = int.from_bytes(
-            hashlib.blake2b(struct.pack("<Q", w) + h, digest_size=8).digest(), "little"
-        )
-        if lo <= v < hi:
-            return f"{w:016x}"
-        w += 1
-
-
 async def wait_until(cond, timeout: float = 5.0):
     t0 = asyncio.get_running_loop().time()
     while not cond():
@@ -641,7 +630,7 @@ def test_raised_request_retargets_inflight_dispatch():
             # A result that would have satisfied the ORIGINAL dispatch is now
             # too weak — the result handler must discard it without claiming
             # the winner lock or resolving anyone's future.
-            weak = solve_between(h, EASY_BASE, raised)
+            weak = solve(h, EASY_BASE, below=raised)
             await t.publish("result/ondemand", f"{h},{weak},{ACCOUNT}")
             await asyncio.sleep(0.1)
             assert not base_task.done() and not raised_task.done()
@@ -713,7 +702,7 @@ def test_raise_landing_mid_dispatch_is_not_clobbered():
                 if m.topic == "work/ondemand"
             ), [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
 
-            weak = solve_between(h, EASY_BASE, raised)
+            weak = solve(h, EASY_BASE, below=raised)
             await t.publish("result/ondemand", f"{h},{weak},{ACCOUNT}")
             await asyncio.sleep(0.1)
             assert not base_task.done() and not raised_task.done()

@@ -419,6 +419,21 @@ class DpowServer:
     # service path (reference dpow_server.py:229-376)
     # ------------------------------------------------------------------
 
+    def _difficulty_lock(self, block_hash: str) -> asyncio.Lock:
+        """Per-hash lock serializing every block-difficulty write/publish
+        (dispatcher and raisers) for one in-flight dispatch."""
+        return self._difficulty_locks.setdefault(block_hash, asyncio.Lock())
+
+    def _drop_dispatch_state(self, block_hash: str) -> None:
+        """Remove ALL per-dispatch side tables for a hash. Single place on
+        purpose: every dict that lives-and-dies with a work_futures entry
+        must be dropped together, or a new table added later silently leaks
+        at whichever teardown site forgot it."""
+        del self.work_futures[block_hash]
+        self._dispatched_difficulty.pop(block_hash, None)
+        self._difficulty_locks.pop(block_hash, None)
+        self._last_publish.pop(block_hash, None)
+
     async def _authenticate(self, data: dict) -> str:
         service, api_key = str(data["user"]), str(data["api_key"])
         db_key = await self.store.hget(f"service:{service}", "api_key")
@@ -572,9 +587,7 @@ class DpowServer:
                 # work and bounce the raiser through RetryRequest, the exact
                 # hole the retarget path exists to close. Under the lock the
                 # in-memory high-water mark is authoritative.
-                async with self._difficulty_locks.setdefault(
-                    block_hash, asyncio.Lock()
-                ):
+                async with self._difficulty_lock(block_hash):
                     effective = max(
                         difficulty,
                         self._dispatched_difficulty.get(block_hash, difficulty),
@@ -610,10 +623,7 @@ class DpowServer:
                 # dispatch installed its own — popping by key would destroy
                 # the successor's future out from under it.
                 if self.work_futures.get(block_hash) is created:
-                    del self.work_futures[block_hash]
-                    self._dispatched_difficulty.pop(block_hash, None)
-                    self._difficulty_locks.pop(block_hash, None)
-                    self._last_publish.pop(block_hash, None)
+                    self._drop_dispatch_state(block_hash)
                 if not created.done():
                     created.cancel()
                 raise
@@ -639,9 +649,7 @@ class DpowServer:
                 # into its running job (client/work_handler.py queue_work;
                 # backend raise_difficulty). Inside the waiter try-block so a
                 # failed publish still tears down our refcount.
-                async with self._difficulty_locks.setdefault(
-                    block_hash, asyncio.Lock()
-                ):
+                async with self._difficulty_lock(block_hash):
                     current = self._dispatched_difficulty.get(
                         block_hash, self.config.base_difficulty
                     )
@@ -701,10 +709,7 @@ class DpowServer:
                 # future IT awaited — by now the key may hold a successor
                 # dispatch's fresh future, which must stay.
                 if self.work_futures.get(block_hash) is fut:
-                    del self.work_futures[block_hash]
-                    self._dispatched_difficulty.pop(block_hash, None)
-                    self._difficulty_locks.pop(block_hash, None)
-                    self._last_publish.pop(block_hash, None)
+                    self._drop_dispatch_state(block_hash)
                 if not fut.done():
                     fut.cancel()
             else:
