@@ -33,7 +33,7 @@ if [ -d /root/.axon_site ]; then
     esac
 fi
 MARK="${1:-capture}"
-STEPS="${CAPTURE_STEPS:-headline,tests_tpu,latency_base,latency_base_x2ladder,flood,batch,fairness,cancel,gang_ab,latency_mesh1,overhead,latency_8x,soak,chaos_crossproc}"
+STEPS="${CAPTURE_STEPS:-headline,tests_tpu,latency_base,latency_base_x2ladder,flood,batch,fairness,cancel,gang_ab,latency_mesh1,overhead,latency_8x,soak,chaos_crossproc,throughput_sweep}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"
 PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
 cd "$REPO"
