@@ -1,0 +1,130 @@
+"""Judge the latest evidence capture against the round-4 success criteria.
+
+Reads BENCH_latency.json and prints one PASS/FAIL/absent line per criterion
+(VERDICT r3 "Next round" item 1 plus this round's additions), so a fresh
+on-chip capture turns into an actionable gap list in one command:
+
+    python benchmarks/summarize_capture.py [--mark r4]
+
+Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
+  headline   ≥ 1e9 H/s on platform tpu
+  flood      ≥ 14 req/s (≈75% of the r3-measured 18.6/s device ceiling)
+  batch      ≤ 1.2x the per-solve hash bound
+  fairness   added_p50 ≥ 0 (a tax, not a credit)
+  cancel     post-cancel added_p50 within the residue bound
+  tests_tpu  rc 0
+  gang_ab    machinery delta reported (informational)
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def res(record):
+    return (record or {}).get("result") or {}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("capture summary vs round criteria")
+    p.add_argument("--mark", default=None,
+                   help="only trust steps recorded with this mark")
+    p.add_argument("--path", default=os.path.join(REPO, "BENCH_latency.json"))
+    args = p.parse_args()
+    try:
+        with open(args.path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"no capture to summarize: {e}")
+        return 1
+
+    def step(name):
+        rec = data.get(name)
+        if not isinstance(rec, dict):
+            return None
+        if args.mark and rec.get("mark") != args.mark:
+            return None  # stale: from a previous revision's capture
+        return rec
+
+    rows = []
+
+    def row(name, ok, detail):
+        rows.append((name, {True: "PASS", False: "FAIL", None: "absent"}[ok], detail))
+
+    r = res(step("headline"))
+    if r:
+        row("headline", r.get("platform") == "tpu" and r.get("value", 0) >= 1e9,
+            f"{r.get('value', 0)/1e9:.3f} GH/s on {r.get('platform')}")
+    else:
+        row("headline", None, "no fresh record")
+
+    r = step("tests_tpu")
+    row("tests_tpu", (r or {}).get("rc") == 0 if r else None,
+        f"rc={(r or {}).get('rc')}" if r else "no fresh record")
+
+    r = res(step("flood"))
+    if r:
+        row("flood", r.get("req_per_sec", 0) >= 14,
+            f"{r.get('req_per_sec')} req/s, p50 {r.get('p50_ms')} ms")
+    else:
+        row("flood", None, "no fresh record")
+
+    r = res(step("batch"))
+    if r and r.get("device_hashes") and r.get("batch") and r.get("difficulty"):
+        # ratio of scanned hashes to the 1/p expectation per solve
+        p_solve = (2**64 - int(r["difficulty"], 16)) / 2**64
+        bound = r["batch"] / p_solve
+        ratio = round(r["device_hashes"] / bound, 3)
+        row("batch", ratio <= 1.2,
+            f"hashes/solve = {ratio}x the 1/p bound "
+            f"({r['solves_per_sec']} solves/s)")
+    else:
+        row("batch", None, "no fresh record")
+
+    r = res(step("fairness"))
+    if r:
+        row("fairness", r.get("added_p50_ms", -1) >= 0,
+            f"added_p50 {r.get('added_p50_ms')} ms (solo {r.get('solo_p50_ms')}, "
+            f"mixed {r.get('mixed_p50_ms')})")
+    else:
+        row("fairness", None, "no fresh record")
+
+    r = res(step("cancel"))
+    if r:
+        # residue bound in ms: bound_windows * ~3.7 ms/window at flagship
+        # throughput, doubled for tunnel jitter.
+        bound_ms = r.get("bound_windows", 20) * 3.7 * 2
+        row("cancel", r.get("added_p50_ms", 1e9) <= bound_ms,
+            f"added_p50 {r.get('added_p50_ms')} ms vs ~{bound_ms:.0f} ms bound")
+    else:
+        row("cancel", None, "no fresh record")
+
+    for informational in ("gang_ab", "latency_mesh1", "latency_base",
+                          "latency_base_x2ladder", "overhead", "chaos_crossproc",
+                          "throughput_sweep"):
+        r = res(step(informational))
+        if r:
+            keep = {k: v for k, v in r.items()
+                    if isinstance(v, (int, float, str)) and k != "bench"}
+            row(informational, True, json.dumps(keep)[:140])
+        else:
+            row(informational, None, "no fresh record")
+
+    width = max(len(n) for n, _, _ in rows)
+    failures = 0
+    for name, status, detail in rows:
+        print(f"{name:<{width}}  {status:<6}  {detail}")
+        failures += status == "FAIL"
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
