@@ -184,7 +184,24 @@ class DpowClient:
         self._tasks = [
             asyncio.ensure_future(self._message_loop()),
             asyncio.ensure_future(self._heartbeat_check_loop()),
+            asyncio.ensure_future(self._engine_stats_loop()),
         ]
+
+    async def _engine_stats_loop(self, interval: float = 60.0) -> None:
+        """Periodic one-line operator snapshot: handler counters (queued /
+        deduped / solved / cancelled / errors — the dedup rate shows how
+        often server re-announcements were absorbed) plus engine totals.
+        The reference's worker only ever logs per-work lines; rates need
+        external scraping there."""
+        while True:
+            await asyncio.sleep(interval)
+            backend = self.work_handler.backend
+            logger.info(
+                "engine stats: %s | device hashes=%s solutions=%s",
+                self.work_handler.stats,
+                getattr(backend, "total_hashes", "n/a"),
+                getattr(backend, "total_solutions", "n/a"),
+            )
 
     async def run(self) -> None:
         """Full lifecycle incl. error→sleep→reconnect (reference :156-197)."""
