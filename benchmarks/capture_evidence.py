@@ -30,7 +30,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "BENCH_latency.json")
+# Env override exists for the test suite (and ad-hoc captures that must not
+# touch the repo artifact).
+OUT = os.environ.get("TPU_DPOW_BENCH_OUT") or os.path.join(REPO, "BENCH_latency.json")
 
 STEPS = [
     ("headline", [sys.executable, "bench.py"], 900),
@@ -62,6 +64,60 @@ STEPS = [
 ]
 
 
+AXON_SITE = "/root/.axon_site"
+# A resumed capture re-runs a previously failed step at most this many times
+# before skipping past it (see the retry-cap comment in main()).
+MAX_STEP_ATTEMPTS = 2
+
+
+def tunnel_alive(timeout: float | None = None) -> bool:
+    """Bounded probe: is the TPU tunnel serving jits right now?
+
+    Used to distinguish "this step failed" from "the tunnel died under the
+    whole capture" — observed live windows can be ~2 min, so once the
+    tunnel is gone every remaining step would just burn its full timeout
+    (hours of dead time that a resumed capture could use instead).
+
+    Honors the same PROBE_TIMEOUT env the watcher uses so the two probes
+    can't disagree about what "alive" means on a slow link. The probe child
+    needs the axon plugin dir on PYTHONPATH (its sitecustomize registers
+    the TPU platform); ensure it the same way watch_and_capture.sh does so
+    a bare `python benchmarks/capture_evidence.py` invocation doesn't
+    mistake its own missing plugin for a dead tunnel.
+    """
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS") == "cpu":
+        # Pinned to CPU (the test env): a TPU probe cannot succeed, and
+        # with the plugin dir on PYTHONPATH during an outage it would just
+        # block for the full timeout first.
+        return False
+    if timeout is None:
+        timeout = float(env.get("PROBE_TIMEOUT", 75))
+    if os.path.isdir(AXON_SITE):
+        parts = env.get("PYTHONPATH", "").split(os.pathsep)
+        if AXON_SITE not in parts:
+            env["PYTHONPATH"] = os.pathsep.join([AXON_SITE] + [p for p in parts if p])
+    probe = (
+        "import jax\n"
+        "jax.jit(lambda a: a + 1)(jax.numpy.ones((8,))).block_until_ready()\n"
+        "raise SystemExit(0 if jax.devices()[0].platform != 'cpu' else 1)\n"
+    )
+    try:
+        # Two layers, mirroring the watcher: the `timeout` binary bounds the
+        # probe (KILL backstop — a half-up tunnel has been observed eating
+        # a plain TERM), and subprocess.run's own timeout (which SIGKILLs)
+        # covers a wedged `timeout` itself so a mid-capture liveness check
+        # can never park the capture through a live window.
+        proc = subprocess.run(
+            ["timeout", "--kill-after=30", str(int(timeout)),
+             sys.executable, "-c", probe], cwd=REPO,
+            capture_output=True, timeout=timeout + 60, env=env,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def load() -> dict:
     try:
         with open(OUT) as f:
@@ -85,18 +141,80 @@ def main() -> int:
                    help="record this value under each step's 'mark' key "
                    "(lets a re-capture watcher distinguish fresh results "
                    "from a previous code revision's)")
+    p.add_argument("--skip_fresh", action="store_true",
+                   help="skip steps already recorded with rc==0 and this "
+                   "--mark (resume a capture a dead tunnel cut short)")
+    p.add_argument("--no_dead_tunnel_abort", action="store_true",
+                   help="keep running remaining steps even after a failed "
+                   "step coincides with a dead tunnel probe (default: "
+                   "abort with rc 3 so the watcher can resume later)")
+    p.add_argument("--steps_file", default=None,
+                   help="JSON file of [name, argv, timeout_s] triples "
+                   "replacing the built-in step list (tests / ad-hoc runs)")
+    p.add_argument("--probe", action="store_true",
+                   help="just probe the tunnel and exit 0 (live) / 1 (dead) "
+                   "— the watcher shares this probe so the two can't "
+                   "disagree about what alive means")
+    p.add_argument("--validate", action="store_true",
+                   help="check the step selection and exit without running "
+                   "anything (the watcher validates BEFORE its probe loop: "
+                   "a typo'd step name must fail at launch, not burn the "
+                   "first live window)")
     args = p.parse_args()
+    if args.probe:
+        return 0 if tunnel_alive() else 1
     steps = STEPS
+    if args.steps_file:
+        with open(args.steps_file) as f:
+            steps = [(n, cmd, t) for n, cmd, t in json.load(f)]
     if args.steps:
         want = {s.strip() for s in args.steps.split(",")}
-        unknown = want - {n for n, _, _ in STEPS}
+        unknown = want - {n for n, _, _ in steps}
         if unknown:
             print(f"unknown steps: {sorted(unknown)}", file=sys.stderr)
             return 2
-        steps = [s for s in STEPS if s[0] in want]
+        steps = [s for s in steps if s[0] in want]
+    if args.validate:
+        print(f"steps ok: {[n for n, _, _ in steps]}")
+        return 0
+    if args.skip_fresh and args.mark is None:
+        # Without a mark, "fresh" would match records from ANY prior code
+        # revision and silently publish stale numbers as a clean finish.
+        print("--skip_fresh requires --mark", file=sys.stderr)
+        return 2
     results = load()
-    results["capture_started_unix"] = round(time.time(), 1)
+    if args.skip_fresh and "capture_started_unix" in results:
+        # Preserve the original start time across resumed windows; log the
+        # resume so artifact provenance stays auditable.
+        results.setdefault("capture_resumed_unix", []).append(round(time.time(), 1))
+    else:
+        results["capture_started_unix"] = round(time.time(), 1)
+    if args.skip_fresh:
+        # A step that keeps failing on a LIVE tunnel must not livelock the
+        # resume loop (each window re-running it, starving everything
+        # below). Deferring it to the END — rather than skipping it —
+        # bounds the starvation without ever permanently dropping a step
+        # (a dead-tunnel kill misattributed as a live failure by a flapping
+        # tunnel would otherwise consume the cap and lose the step forever).
+        def _capped(name):
+            prior = results.get(name)
+            return (isinstance(prior, dict) and prior.get("mark") == args.mark
+                    and prior.get("rc") != 0
+                    and int(prior.get("attempts", 1)) >= MAX_STEP_ATTEMPTS)
+
+        deferred = [s for s in steps if _capped(s[0])]
+        if deferred:
+            steps = [s for s in steps if not _capped(s[0])] + deferred
+            print(f"deferring to end (failed >={MAX_STEP_ATTEMPTS}x live): "
+                  f"{[n for n, _, _ in deferred]}", flush=True)
     for name, cmd, timeout in steps:
+        prior = results.get(name)
+        prior_marked = (isinstance(prior, dict)
+                        and (args.mark is None or prior.get("mark") == args.mark))
+        if args.skip_fresh and prior_marked and prior.get("rc") == 0:
+            print(f"== {name}: fresh (rc 0, mark {args.mark!r}), skipping",
+                  flush=True)
+            continue
         print(f"== {name}: {' '.join(cmd)}", flush=True)
         t0 = time.time()
         try:
@@ -123,9 +241,30 @@ def main() -> int:
             # able to collide with (and overwrite) the reserved record keys
             # rc/seconds/result/tail/stderr_tail.
             record["mark"] = args.mark
+        failed = record["rc"] != 0
+        tunnel_died = (failed and not args.no_dead_tunnel_abort
+                       and not tunnel_alive())
+        if prior_marked:
+            if tunnel_died:
+                # A failure the probe attributes to the tunnel dying must
+                # not consume the retry budget: with ~2-min live windows
+                # and 900 s step timeouts, two outage-killed runs would
+                # otherwise permanently skip the step via the retry cap.
+                if "attempts" in prior:
+                    record["attempts"] = prior["attempts"]
+            else:
+                record["attempts"] = int(prior.get("attempts", 1)) + 1
         results[name] = record
         save(results)  # progressive: a dead tunnel still leaves earlier steps
         print(f"   -> {json.dumps(record)[:240]}", flush=True)
+        if tunnel_died:
+            results["capture_aborted_dead_tunnel_unix"] = round(time.time(), 1)
+            save(results)
+            print(f"!! tunnel dead after failed step {name}; aborting so "
+                  "the watcher can resume (--skip_fresh) on the next "
+                  "window", flush=True)
+            return 3
+    results.pop("capture_aborted_dead_tunnel_unix", None)
     results["capture_finished_unix"] = round(time.time(), 1)
     save(results)
     return 0

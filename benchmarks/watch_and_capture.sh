@@ -34,32 +34,64 @@ if [ -d /root/.axon_site ]; then
 fi
 MARK="${1:-capture}"
 STEPS="${CAPTURE_STEPS:-headline,tests_tpu,latency_base,latency_base_x2ladder,flood,batch,fairness,cancel,gang_ab,latency_mesh1,overhead,latency_8x,soak,chaos_crossproc,throughput_sweep}"
-PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"
-PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
+# Live windows as short as ~2 min have been observed (r4: live 01:00:58Z,
+# dead by 01:01:28Z). A live probe completes in ~15 s, so a 75 s bound is
+# generous; a short interval keeps the probe cycle (~2 min when down) from
+# straddling an entire window.
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-75}"
+# Exported: capture_evidence.py's shared probe (tunnel_alive) reads the same
+# env var — an unexported value would silently leave the mid-capture
+# dead-tunnel check at its own default.
+export PROBE_TIMEOUT
+PROBE_INTERVAL="${PROBE_INTERVAL:-60}"
 cd "$REPO"
 
+# A typo'd step name must fail NOW, at launch, not as rc 2 after the probe
+# loop finally finds a live window. PYTHONPATH is stripped because validate
+# needs no jax — with the axon dir on the path, interpreter startup itself
+# touches the tunnel and would hang the watcher at launch during an outage
+# (the normal launch condition); timeout is a backstop on top.
+if ! PYTHONPATH= timeout 60 \
+        python benchmarks/capture_evidence.py --steps "$STEPS" --validate; then
+    echo "$(date -u +%FT%TZ) FATAL: bad step selection: $STEPS"
+    exit 2
+fi
+
 probe() {
-    # --kill-after: a probe wedged in an uninterruptible tunnel call can
-    # shrug off the TERM; without the KILL backstop one stuck probe parks
-    # the watcher forever (observed: a half-up tunnel ate the TERM and the
-    # watcher sat 6+ min past its own timeout).
-    timeout --kill-after=30 "$PROBE_TIMEOUT" python - <<'EOF'
-import jax
-jax.jit(lambda a: a + 1)(jax.numpy.ones((8,))).block_until_ready()
-raise SystemExit(0 if jax.devices()[0].platform != "cpu" else 1)
-EOF
+    # Shared with capture_evidence.py's mid-capture liveness check so the
+    # two can never disagree about what "alive" means; both honor the same
+    # PROBE_TIMEOUT env. The outer timeout backstops the parent process
+    # itself with --kill-after, because a probe wedged in an
+    # uninterruptible tunnel call can shrug off the TERM (observed: a
+    # half-up tunnel ate the TERM and the watcher sat 6+ min past its own
+    # timeout); the probe's jax child is SIGKILLed by subprocess timeout.
+    # +120 headroom: the wrapper interpreter's own startup pays plugin
+    # registration over the tunnel (seconds-to-tens on a degraded link) and
+    # the inner layers already use up to PROBE_TIMEOUT+30; a tight outer
+    # bound would TERM a slow-but-live probe and misreport a real window.
+    timeout --kill-after=30 $(( PROBE_TIMEOUT + 120 )) \
+        python benchmarks/capture_evidence.py --probe
 }
 
 while true; do
     if probe; then
         echo "$(date -u +%FT%TZ) tunnel LIVE -> capturing (mark=$MARK steps=$STEPS)"
-        python benchmarks/capture_evidence.py --steps "$STEPS" --mark "$MARK"
-        echo "$(date -u +%FT%TZ) capture done; timing a cold-process bench.py (compile-cache proof)"
-        start=$(date +%s)
-        python bench.py
-        echo "cold_bench_seconds=$(( $(date +%s) - start ))"
-        echo "$(date -u +%FT%TZ) watcher done"
-        exit 0
+        # --skip_fresh resumes a capture a dead tunnel cut short: steps
+        # already recorded rc==0 with this mark are kept, the rest re-run.
+        # rc 3 = capture aborted because the tunnel died mid-run; keep
+        # watching and resume on the next window. Any other rc: done.
+        python benchmarks/capture_evidence.py \
+            --steps "$STEPS" --mark "$MARK" --skip_fresh
+        rc=$?
+        if [ "$rc" -ne 3 ]; then
+            echo "$(date -u +%FT%TZ) capture done (rc=$rc); timing a cold-process bench.py (compile-cache proof)"
+            start=$(date +%s)
+            python bench.py
+            echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+            echo "$(date -u +%FT%TZ) watcher done"
+            exit 0
+        fi
+        echo "$(date -u +%FT%TZ) capture interrupted by tunnel death; resuming watch"
     fi
     echo "$(date -u +%FT%TZ) tunnel down; retry in ${PROBE_INTERVAL}s"
     sleep "$PROBE_INTERVAL"

@@ -1,0 +1,175 @@
+"""capture_evidence.py contract — the tool that turns a live tunnel window
+into BENCH_latency.json. Observed live windows can be ~2 min (r4: live
+01:00:58Z, probe dead 30 s later), so the capture must (a) resume across
+windows instead of re-running landed steps, and (b) abort the moment a
+failed step coincides with a dead tunnel rather than burning every
+remaining step's full timeout. Both behaviors are pinned here with stub
+steps in a subprocess, against a temp artifact (TPU_DPOW_BENCH_OUT)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "capture_evidence.py")
+
+
+def run_capture(tmp_path, steps, argv_extra, out_name="bench.json", prior=None):
+    out = tmp_path / out_name
+    if prior is not None:
+        out.write_text(json.dumps(prior))
+    steps_file = tmp_path / "steps.json"
+    steps_file.write_text(json.dumps(steps))
+    env = dict(os.environ)
+    env["TPU_DPOW_BENCH_OUT"] = str(out)
+    # The dead-tunnel probe must see a CPU-only jax quickly, not block on a
+    # half-up accelerator plugin: strip any plugin dirs from PYTHONPATH and
+    # force the CPU platform (same rationale as tests/conftest.py).
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--steps_file", str(steps_file)] + argv_extra,
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    data = json.loads(out.read_text()) if out.exists() else {}
+    return proc, data
+
+
+def ok_step(name):
+    return [name, [sys.executable, "-c",
+                   f"import json; print(json.dumps({{'step': '{name}'}}))"], 30]
+
+
+def fail_step(name):
+    return [name, [sys.executable, "-c", "raise SystemExit(1)"], 30]
+
+
+def test_steps_record_result_and_mark(tmp_path):
+    proc, data = run_capture(
+        tmp_path, [ok_step("a"), ok_step("b")], ["--mark", "t1"])
+    assert proc.returncode == 0, proc.stderr
+    assert data["a"]["rc"] == 0 and data["a"]["result"] == {"step": "a"}
+    assert data["b"]["mark"] == "t1"
+    assert "capture_finished_unix" in data
+
+
+def test_skip_fresh_skips_only_matching_mark_and_rc0(tmp_path):
+    prior = {
+        "a": {"rc": 0, "mark": "t1", "result": {"step": "stale-code"}},
+        "b": {"rc": 1, "mark": "t1"},          # failed: must re-run
+        "c": {"rc": 0, "mark": "OLDMARK"},     # old revision: must re-run
+    }
+    proc, data = run_capture(
+        tmp_path, [ok_step("a"), ok_step("b"), ok_step("c")],
+        ["--mark", "t1", "--skip_fresh"], prior=prior)
+    assert proc.returncode == 0, proc.stderr
+    assert data["a"]["result"] == {"step": "stale-code"}  # untouched
+    assert data["b"]["rc"] == 0 and data["b"]["result"] == {"step": "b"}
+    assert data["c"]["mark"] == "t1"
+    assert "skipping" in proc.stdout
+
+
+def test_failed_step_with_dead_tunnel_aborts_rc3(tmp_path):
+    # JAX_PLATFORMS=cpu makes the liveness probe report "dead" (platform is
+    # cpu), so the first failing step must abort the rest of the capture.
+    proc, data = run_capture(
+        tmp_path, [fail_step("a"), ok_step("never")], ["--mark", "t1"])
+    assert proc.returncode == 3, (proc.stdout, proc.stderr)
+    assert data["a"]["rc"] == 1
+    assert "never" not in data
+    assert "capture_aborted_dead_tunnel_unix" in data
+    assert "capture_finished_unix" not in data
+
+
+def test_retry_capped_step_deferred_to_end(tmp_path):
+    # A step that keeps failing on a live tunnel must not livelock the
+    # resume loop — but it must not be dropped forever either (a flapping
+    # tunnel can misattribute outage kills as live failures). It runs LAST.
+    prior = {"a": {"rc": 1, "mark": "t1", "attempts": 2}}
+    proc, data = run_capture(
+        tmp_path, [fail_step("a"), ok_step("b")],
+        ["--mark", "t1", "--skip_fresh", "--no_dead_tunnel_abort"],
+        prior=prior)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "deferring to end" in proc.stdout
+    assert proc.stdout.index("== b:") < proc.stdout.index("== a:")
+    assert data["b"]["rc"] == 0
+    assert data["a"]["attempts"] == 3          # re-run (at the end), counted
+    assert "capture_finished_unix" in data
+
+
+def test_skip_fresh_requires_mark(tmp_path):
+    proc, data = run_capture(tmp_path, [ok_step("a")], ["--skip_fresh"])
+    assert proc.returncode == 2
+    assert "requires --mark" in proc.stderr
+    assert data == {}
+
+
+def test_resume_preserves_original_start_time(tmp_path):
+    prior = {"capture_started_unix": 111.5,
+             "a": {"rc": 0, "mark": "t1"}}
+    proc, data = run_capture(
+        tmp_path, [ok_step("a"), ok_step("b")],
+        ["--mark", "t1", "--skip_fresh"], prior=prior)
+    assert proc.returncode == 0, proc.stderr
+    assert data["capture_started_unix"] == 111.5
+    assert len(data["capture_resumed_unix"]) == 1
+
+
+def test_failed_step_attempts_counted_across_resumes(tmp_path):
+    prior = {"a": {"rc": 1, "mark": "t1"},
+             "capture_aborted_dead_tunnel_unix": 123.0}
+    proc, data = run_capture(
+        tmp_path, [fail_step("a"), ok_step("b")],
+        ["--mark", "t1", "--skip_fresh", "--no_dead_tunnel_abort"],
+        prior=prior)
+    assert proc.returncode == 0, proc.stderr
+    assert data["a"]["attempts"] == 2
+    # a completed capture clears the stale abort marker
+    assert "capture_aborted_dead_tunnel_unix" not in data
+    assert "capture_finished_unix" in data
+
+
+def test_probe_mode_reports_dead_when_pinned_to_cpu(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, SCRIPT, "--probe"],
+                          capture_output=True, text=True, timeout=60,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 1
+
+
+def test_dead_tunnel_failure_does_not_consume_retry_budget(tmp_path):
+    # A step killed by the tunnel dying must be retryable forever: only
+    # live-tunnel failures count toward MAX_STEP_ATTEMPTS, else two outage
+    # windows would permanently skip the top-priority step.
+    prior = {"a": {"rc": 1, "mark": "t1", "attempts": 1}}
+    proc, data = run_capture(
+        tmp_path, [fail_step("a")], ["--mark", "t1", "--skip_fresh"],
+        prior=prior)
+    assert proc.returncode == 3
+    assert data["a"]["attempts"] == 1   # unchanged: this failure was "dead tunnel"
+
+
+def test_validate_catches_typod_step_name(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    ok = subprocess.run(
+        [sys.executable, SCRIPT, "--steps", "headline,flood", "--validate"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert ok.returncode == 0 and "steps ok" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, SCRIPT, "--steps", "headlne", "--validate"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert bad.returncode == 2 and "headlne" in bad.stderr
+
+
+def test_no_dead_tunnel_abort_flag_keeps_going(tmp_path):
+    proc, data = run_capture(
+        tmp_path, [fail_step("a"), ok_step("b")],
+        ["--mark", "t1", "--no_dead_tunnel_abort"])
+    assert proc.returncode == 0, proc.stderr
+    assert data["a"]["rc"] == 1 and data["b"]["rc"] == 0
+    assert "capture_finished_unix" in data
