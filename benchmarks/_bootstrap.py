@@ -19,6 +19,81 @@ from tpu_dpow.utils import honor_jax_platforms_env  # noqa: E402
 honor_jax_platforms_env()
 
 
+async def start_full_stack(debug: bool = False):
+    """In-process full stack for the e2e benches (flood, precache).
+
+    Broker + server + HTTP runner + one worker client on the jax backend,
+    registered under service credentials bench/bench and warmed. One copy on
+    purpose: the two benches measuring the same stack must not drift apart
+    in how they configure it. Caller tears down with
+    ``await stack.client.close(); await stack.runner.stop()``.
+
+    ``debug=True`` makes every confirmed block precache-eligible
+    (server/app.py block_arrival_handler) without seeding frontiers first.
+    """
+    from types import SimpleNamespace
+
+    import jax
+
+    from tpu_dpow.backend.jax_backend import JaxWorkBackend
+    from tpu_dpow.client import ClientConfig, DpowClient
+    from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+    from tpu_dpow.server.api import ServerRunner
+    from tpu_dpow.store import MemoryStore
+    from tpu_dpow.transport import default_users
+    from tpu_dpow.transport.broker import Broker
+    from tpu_dpow.transport.inproc import InProcTransport
+    from tpu_dpow.utils import nanocrypto as nc
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    config = ServerConfig(
+        # Off-TPU the difficulty drops so the stack (not the scan) is the
+        # measured path and the harness stays runnable anywhere.
+        base_difficulty=nc.BASE_DIFFICULTY if on_tpu else 0xFF00000000000000,
+        throttle=100000.0,
+        heartbeat_interval=0.5,
+        statistics_interval=3600.0,
+        default_timeout=30.0,
+        debug=debug,
+        service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+    )
+    broker = Broker(users=default_users())
+    store = MemoryStore()
+    server = DpowServer(
+        config, store,
+        InProcTransport(broker, client_id="server",
+                        username="dpowserver", password="dpowserver"),
+    )
+    runner = ServerRunner(server, config)
+    await runner.start()
+    await store.hset(
+        "service:bench",
+        {"api_key": hash_key("bench"), "public": "N", "display": "bench",
+         "website": "", "precache": "0", "ondemand": "0"},
+    )
+    await store.sadd("services", "bench")
+
+    backend = (
+        JaxWorkBackend()
+        if on_tpu
+        else JaxWorkBackend(kernel="xla", sublanes=8, iters=8, max_batch=32)
+    )
+    client = DpowClient(
+        ClientConfig(payout_address=nc.encode_account(bytes(range(32))),
+                     startup_heartbeat_wait=3.0),
+        InProcTransport(broker, client_id="worker", clean_session=False,
+                        username="client", password="client"),
+        backend=backend,
+    )
+    await client.setup()
+    client.start_loops()
+    await wait_for_warmup(backend, timeout=360)
+    return SimpleNamespace(
+        runner=runner, store=store, server=server, client=client,
+        backend=backend, on_tpu=on_tpu, ports=runner.ports,
+    )
+
+
 async def wait_for_warmup(backend, timeout: float = 600.0) -> None:
     """Block until the backend's launch-shape warm task finishes (if any).
 

@@ -46,6 +46,7 @@ STEPS = [
     ("flood", [sys.executable, "benchmarks/flood.py", "--n", "100",
                "--concurrency", "20"], 900),
     ("fairness", [sys.executable, "benchmarks/fairness.py", "--n", "10"], 900),
+    ("precache", [sys.executable, "benchmarks/precache.py", "--n", "30"], 600),
     ("cancel", [sys.executable, "benchmarks/cancel_latency.py", "--n", "10"], 600),
     ("gang_ab", [sys.executable, "benchmarks/gang_ab.py", "--reps", "20"], 600),
     ("latency_mesh1", [sys.executable, "benchmarks/latency.py", "--n", "15",

@@ -22,66 +22,13 @@ import time
 import aiohttp
 import numpy as np
 
-from tpu_dpow.backend.jax_backend import JaxWorkBackend
-from tpu_dpow.client import ClientConfig, DpowClient
-from tpu_dpow.server import DpowServer, ServerConfig, hash_key
-from tpu_dpow.server.api import ServerRunner
-from tpu_dpow.store import MemoryStore
-from tpu_dpow.transport import default_users
-from tpu_dpow.transport.broker import Broker
-from tpu_dpow.transport.inproc import InProcTransport
-from tpu_dpow.utils import nanocrypto as nc
-
 RNG = np.random.default_rng(0xF1)
-PAYOUT = nc.encode_account(bytes(range(32)))
 
 
 async def run(n: int, concurrency: int) -> None:
-    import jax
+    stack = await _bootstrap.start_full_stack()
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    base_difficulty = nc.BASE_DIFFICULTY if on_tpu else 0xFF00000000000000
-
-    broker = Broker(users=default_users())
-    server_auth = {"username": "dpowserver", "password": "dpowserver"}
-    client_auth = {"username": "client", "password": "client"}
-    config = ServerConfig(
-        base_difficulty=base_difficulty,
-        throttle=100000.0,
-        heartbeat_interval=0.5,
-        statistics_interval=3600.0,
-        default_timeout=30.0,
-        service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
-    )
-    store = MemoryStore()
-    server = DpowServer(
-        config, store, InProcTransport(broker, client_id="server", **server_auth)
-    )
-    runner = ServerRunner(server, config)
-    await runner.start()
-    await store.hset(
-        "service:bench",
-        {"api_key": hash_key("bench"), "public": "N", "display": "bench",
-         "website": "", "precache": "0", "ondemand": "0"},
-    )
-    await store.sadd("services", "bench")
-
-    backend = (
-        JaxWorkBackend()
-        if on_tpu
-        else JaxWorkBackend(kernel="xla", sublanes=8, iters=8, max_batch=32)
-    )
-    client = DpowClient(
-        ClientConfig(payout_address=PAYOUT, startup_heartbeat_wait=3.0),
-        InProcTransport(broker, client_id="worker", clean_session=False, **client_auth),
-        backend=backend,
-    )
-    await client.setup()
-    client.start_loops()
-    await _bootstrap.wait_for_warmup(backend, timeout=360)
-
-    port = runner.ports["service"]
-    url = f"http://127.0.0.1:{port}/service/"
+    url = f"http://127.0.0.1:{stack.ports['service']}/service/"
     sem = asyncio.Semaphore(concurrency)
     times: list = []
     errors = [0]
@@ -107,15 +54,15 @@ async def run(n: int, concurrency: int) -> None:
         await asyncio.gather(*(one(session) for _ in range(n)))
     wall = time.perf_counter() - t0
 
-    await client.close()
-    await runner.stop()
+    await stack.client.close()
+    await stack.runner.stop()
 
     ms = np.asarray(sorted(times)) * 1e3
     print(
         json.dumps(
             {
                 "bench": "e2e_flood",
-                "platform": "tpu" if on_tpu else "cpu",
+                "platform": "tpu" if stack.on_tpu else "cpu",
                 "n": n,
                 "concurrency": concurrency,
                 "ok": len(times),

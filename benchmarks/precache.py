@@ -1,0 +1,109 @@
+"""Precache pipeline + hit latency (the reference's temporal-pipelining path).
+
+Measures the two halves of the precache story end-to-end through the real
+stack (HTTP block callback → server frontier logic → work/precache publish →
+worker client → device backend → result → cache; then HTTP service request →
+cache hit):
+
+  * ``pipeline_ms``  — block confirmation → work cached and ready
+    (how far ahead of the service request the answer lands);
+  * ``hit_ms``       — service POST for an already-precached hash → response
+    (the reference's entire pitch: this path does zero device work, so it
+    must sit at HTTP-round-trip cost; round 2 measured p50 1.8 ms).
+
+Usage: python benchmarks/precache.py [--n 30]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import asyncio
+import json
+import time
+
+import aiohttp
+import numpy as np
+
+from tpu_dpow.server.app import WORK_PENDING
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0xBC)
+
+
+async def run(n: int) -> None:
+    stack = await _bootstrap.start_full_stack(debug=True)
+
+    block_url = f"http://127.0.0.1:{stack.ports['blocks']}/block/"
+    service_url = f"http://127.0.0.1:{stack.ports['service']}/service/"
+
+    pipeline_ms: list = []
+    hit_ms: list = []
+    errors = 0
+
+    async with aiohttp.ClientSession() as session:
+        for _ in range(n):
+            block_hash = RNG.bytes(32).hex().upper()
+            account = nc.encode_account(RNG.bytes(32))
+            confirm = {
+                "hash": block_hash,
+                "account": account,
+                "block": {"previous": RNG.bytes(32).hex().upper()},
+            }
+            t0 = time.perf_counter()
+            async with session.post(block_url, json=confirm) as resp:
+                await resp.read()
+            # Poll the cache until the precached answer lands. 1 ms grain:
+            # the pipeline is tens-of-ms (device solve) so the poll error is
+            # noise; a pub/sub hook would measure the server, not the stack.
+            while True:
+                work = await stack.store.get(f"block:{block_hash}")
+                if work is not None and work != WORK_PENDING:
+                    break
+                if time.perf_counter() - t0 > 60:
+                    break
+                await asyncio.sleep(0.001)
+            if work is None or work == WORK_PENDING:
+                errors += 1
+                continue
+            pipeline_ms.append((time.perf_counter() - t0) * 1e3)
+
+            body = {"user": "bench", "api_key": "bench",
+                    "hash": block_hash, "timeout": 30}
+            t1 = time.perf_counter()
+            async with session.post(service_url, json=body) as resp:
+                data = await resp.json()
+            if data.get("work"):
+                hit_ms.append((time.perf_counter() - t1) * 1e3)
+            else:
+                errors += 1
+
+    await stack.client.close()
+    await stack.runner.stop()
+
+    def pct(values, q):
+        return round(float(np.percentile(np.asarray(values), q)), 2) if values else None
+
+    print(
+        json.dumps(
+            {
+                "bench": "precache",
+                "platform": "tpu" if stack.on_tpu else "cpu",
+                "n": n,
+                "ok": len(hit_ms),
+                "errors": errors,
+                "pipeline_p50_ms": pct(pipeline_ms, 50),
+                "pipeline_p95_ms": pct(pipeline_ms, 95),
+                "hit_p50_ms": pct(hit_ms, 50),
+                "hit_p95_ms": pct(hit_ms, 95),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=30)
+    args = p.parse_args()
+    asyncio.run(run(args.n))

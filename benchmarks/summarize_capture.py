@@ -11,6 +11,7 @@ Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
   flood      ≥ 14 req/s (≈75% of the r3-measured 18.6/s device ceiling)
   batch      ≤ 1.2x the per-solve hash bound
   fairness   added_p50 ≥ 0 (a tax, not a credit)
+  precache   hit p50 ≤ 25 ms with zero errors (cache hit, not device wait)
   cancel     post-cancel added_p50 within the residue bound
   tests_tpu  rc 0
   gang_ab    machinery delta reported (informational)
@@ -104,6 +105,17 @@ def main() -> int:
             f"added_p50 {r.get('added_p50_ms')} ms vs ~{bound_ms:.0f} ms bound")
     else:
         row("cancel", None, "no fresh record")
+
+    r = res(step("precache"))
+    if r:
+        # The hit path does zero device work; r2 measured p50 1.8 ms. Allow
+        # generous headroom — anything near one HTTP round trip passes, a
+        # hit that waits on the device (~100+ ms through the tunnel) fails.
+        row("precache", (r.get("hit_p50_ms") or 1e9) <= 25 and r.get("errors") == 0,
+            f"hit p50 {r.get('hit_p50_ms')} ms, pipeline p50 "
+            f"{r.get('pipeline_p50_ms')} ms, errors {r.get('errors')}")
+    else:
+        row("precache", None, "no fresh record")
 
     for informational in ("gang_ab", "latency_mesh1", "latency_base",
                           "latency_base_x2ladder", "overhead", "chaos_crossproc",
