@@ -19,7 +19,9 @@ Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
 
 from __future__ import annotations
 
-import _bootstrap  # noqa: F401  (repo root on sys.path)
+# No _bootstrap import on purpose: the summarizer is pure-JSON arithmetic,
+# and _bootstrap's jax import costs ~2 s per invocation (it runs once per
+# test case in tests/test_summarize_capture.py).
 
 import argparse
 import json

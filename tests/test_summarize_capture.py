@@ -1,0 +1,93 @@
+"""summarize_capture.py contract — the tool that turns BENCH_latency.json
+into the round's PASS/FAIL gap list. A bug here misreports the evidence
+the whole round exists to produce (a false PASS hides a regression; a
+false FAIL sends the next session chasing a ghost), so the criteria
+arithmetic and the mark staleness filter are pinned against synthetic
+artifacts."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "summarize_capture.py")
+
+
+def summarize(tmp_path, data, argv=()):
+    path = tmp_path / "bench.json"
+    path.write_text(json.dumps(data))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--path", str(path), *argv],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    rows = {}
+    for line in proc.stdout.splitlines():
+        parts = line.split(None, 2)
+        if len(parts) >= 2:
+            rows[parts[0]] = (parts[1], parts[2] if len(parts) > 2 else "")
+    return proc, rows
+
+
+def test_headline_pass_requires_tpu_platform(tmp_path):
+    data = {"headline": {"rc": 0, "result": {
+        "platform": "cpu", "value": 2e9, "unit": "H/s"}}}
+    _, rows = summarize(tmp_path, data)
+    assert rows["headline"][0] == "FAIL"
+    data["headline"]["result"]["platform"] = "tpu"
+    _, rows = summarize(tmp_path, data)
+    assert rows["headline"][0] == "PASS"
+
+
+def test_batch_ratio_math_against_difficulty(tmp_path):
+    # p(solve) = (2^64 - difficulty)/2^64 = 2^-26 at base difficulty, so a
+    # batch of 64 expects 64 * 2^26 hashes; exactly that many = ratio 1.0.
+    difficulty = "ffffffc000000000"
+    p_solve = (2**64 - int(difficulty, 16)) / 2**64
+    data = {"batch": {"rc": 0, "result": {
+        "batch": 64, "difficulty": difficulty, "solves_per_sec": 10.0,
+        "device_hashes": 64 / p_solve}}}
+    _, rows = summarize(tmp_path, data)
+    assert rows["batch"][0] == "PASS" and "1.0x" in rows["batch"][1]
+    data["batch"]["result"]["device_hashes"] = 1.5 * 64 / p_solve
+    _, rows = summarize(tmp_path, data)
+    assert rows["batch"][0] == "FAIL" and "1.5x" in rows["batch"][1]
+
+
+def test_mark_filter_rejects_stale_records(tmp_path):
+    data = {"fairness": {"rc": 0, "mark": "r3",
+                         "result": {"added_p50_ms": 5.0}}}
+    _, rows = summarize(tmp_path, data, ["--mark", "r4"])
+    assert rows["fairness"][0] == "absent"
+    _, rows = summarize(tmp_path, data, ["--mark", "r3"])
+    assert rows["fairness"][0] == "PASS"
+
+
+def test_fairness_requires_nonnegative_tax(tmp_path):
+    data = {"fairness": {"rc": 0, "result": {"added_p50_ms": -145.7}}}
+    _, rows = summarize(tmp_path, data)
+    assert rows["fairness"][0] == "FAIL"
+
+
+def test_precache_gates_on_hit_latency_and_errors(tmp_path):
+    rec = {"rc": 0, "result": {"hit_p50_ms": 1.8, "pipeline_p50_ms": 40.0,
+                               "errors": 0}}
+    _, rows = summarize(tmp_path, {"precache": rec})
+    assert rows["precache"][0] == "PASS"
+    rec["result"]["hit_p50_ms"] = 130.0  # a device wait, not a cache hit
+    _, rows = summarize(tmp_path, {"precache": rec})
+    assert rows["precache"][0] == "FAIL"
+    rec["result"]["hit_p50_ms"] = 1.8
+    rec["result"]["errors"] = 2
+    _, rows = summarize(tmp_path, {"precache": rec})
+    assert rows["precache"][0] == "FAIL"
+
+
+def test_exit_code_reflects_failures(tmp_path):
+    ok = {"flood": {"rc": 0, "result": {"req_per_sec": 15.0, "p50_ms": 900}}}
+    proc, _ = summarize(tmp_path, ok)
+    assert proc.returncode == 0
+    bad = {"flood": {"rc": 0, "result": {"req_per_sec": 9.4, "p50_ms": 2000}}}
+    proc, rows = summarize(tmp_path, bad)
+    assert proc.returncode == 1 and rows["flood"][0] == "FAIL"
