@@ -52,6 +52,20 @@ def _tpu_available() -> bool:
 
 def pytest_collection_modifyitems(config, items):
     if _tpu_available():
+        # Reuse the persistent compile cache bench.py and the tunnel
+        # watcher warm (tpu_dpow.utils.default_compilation_cache_dir):
+        # every distinct launch shape is tens of seconds of XLA compile
+        # through the tunnel, and live windows can be ~2 min — a suite
+        # that re-pays cold compiles may never fit inside one. The
+        # cache-reload test is unaffected (its subprocesses point at their
+        # own tmp dir).
+        from tpu_dpow.utils import (
+            default_compilation_cache_dir,
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(default_compilation_cache_dir(),
+                                 min_compile_secs=0.5)
         return
     skip = pytest.mark.skip(reason=f"no TPU reachable (probe: {_platform})")
     for item in items:
