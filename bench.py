@@ -62,10 +62,11 @@ def measure(reps: int = 8) -> dict:
         # Persist compiled executables across bench children/driver runs:
         # retry attempts (and future rounds on this machine) then skip the
         # cold-compile window entirely. Best-effort — harmless where the
-        # backend cannot serialize executables.
-        from tpu_dpow.utils import default_compilation_cache_dir, enable_compilation_cache
+        # backend cannot serialize executables. Shared helper: one opt-out
+        # (TPU_DPOW_NO_COMPILE_CACHE) and one cache location everywhere.
+        from tpu_dpow.utils import enable_default_compilation_cache
 
-        enable_compilation_cache(default_compilation_cache_dir())
+        enable_default_compilation_cache(min_compile_secs=1.0)
     except Exception:
         pass
 

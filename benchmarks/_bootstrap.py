@@ -18,6 +18,19 @@ from tpu_dpow.utils import honor_jax_platforms_env  # noqa: E402
 
 honor_jax_platforms_env()
 
+# Every bench runs as its own process, and each distinct launch shape is
+# tens of seconds of XLA compile through the remote-chip tunnel — cold
+# compiles both contaminated round 3's latency numbers (the "cold ladder")
+# and can eat an entire short tunnel window before the first measurement.
+# Share the persistent cache bench.py and the watcher warm; measurements
+# themselves are steady-state (every bench warms before timing), so a
+# cache hit only removes warmup cost, never the measured path. Configured
+# via env (no jax import — pure-host benches stay fast; children inherit);
+# TPU_DPOW_NO_COMPILE_CACHE=1 opts out for compile-behavior experiments.
+from tpu_dpow.utils import enable_default_compilation_cache  # noqa: E402
+
+enable_default_compilation_cache()
+
 
 async def start_full_stack(debug: bool = False):
     """In-process full stack for the e2e benches (flood, precache).

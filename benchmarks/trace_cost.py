@@ -25,7 +25,14 @@ shrinks ~5x.
 
 from __future__ import annotations
 
-import _bootstrap  # noqa: F401  (repo root on sys.path)
+import os
+
+# Compile cost is a MEASURED OUTPUT here (compile_s below), so this bench
+# must see real Mosaic compiles, not persistent-cache loads — opt out
+# before _bootstrap wires the shared cache up.
+os.environ.setdefault("TPU_DPOW_NO_COMPILE_CACHE", "1")
+
+import _bootstrap  # noqa: F401,E402  (repo root on sys.path)
 
 import argparse
 import json

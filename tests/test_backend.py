@@ -721,6 +721,73 @@ def test_compilation_cache_populates(tmp_path):
             )
 
 
+def test_enable_default_compilation_cache_env_contract(monkeypatch):
+    """The shared-cache helper is the SINGLE opt-in point for bench.py,
+    the bench bootstrap, and the on-chip suite: it must wire the cache
+    through jax's env-var-backed knobs (children inherit; pure-host
+    processes never import jax), honor the opt-out, and undo an inherited
+    shared dir under the opt-out — but never a deliberately custom one."""
+    import os
+
+    from tpu_dpow.utils import (
+        default_compilation_cache_dir,
+        enable_default_compilation_cache,
+    )
+
+    import jax
+
+    shared = default_compilation_cache_dir()
+    for var in ("JAX_COMPILATION_CACHE_DIR",
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                "JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES",
+                "TPU_DPOW_NO_COMPILE_CACHE"):
+        monkeypatch.delenv(var, raising=False)
+
+    # jax is imported in this suite, so the helper also applies the config
+    # in-process — capture and restore the suite's own cache settings.
+    prior = {k: getattr(jax.config, k) for k in (
+        "jax_compilation_cache_dir",
+        "jax_persistent_cache_min_compile_time_secs",
+        "jax_persistent_cache_enable_xla_caches")}
+    try:
+        enable_default_compilation_cache(min_compile_secs=0.5)
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == shared
+        assert os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.5"
+        assert os.environ["JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES"] == "all"
+        # jax is imported here, so the in-process config latches too.
+        assert jax.config.jax_compilation_cache_dir == shared
+
+        # Opt-out undoes an inherited SHARED dir (child of a caching
+        # parent) — in the env AND in the live jax config.
+        monkeypatch.setenv("TPU_DPOW_NO_COMPILE_CACHE", "1")
+        enable_default_compilation_cache()
+        assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+        assert jax.config.jax_compilation_cache_dir is None
+
+        # ...but leaves a custom dir alone.
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom/dir")
+        enable_default_compilation_cache()
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == "/custom/dir"
+
+        # "=0" means NOT opted out ("=1 opts out" is the documented
+        # contract; string truthiness must not invert it), and an enable
+        # with a custom dir already in env applies THAT dir in-process.
+        monkeypatch.setenv("TPU_DPOW_NO_COMPILE_CACHE", "0")
+        enable_default_compilation_cache(min_compile_secs=0.5)
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == "/custom/dir"
+        assert jax.config.jax_compilation_cache_dir == "/custom/dir"
+    finally:
+        # The helper writes env directly (monkeypatch only tracks vars it
+        # touched itself), so drop whatever this test's calls left behind;
+        # monkeypatch teardown then restores any pre-existing values.
+        for var in ("JAX_COMPILATION_CACHE_DIR",
+                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                    "JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES"):
+            os.environ.pop(var, None)
+        for k, v in prior.items():
+            jax.config.update(k, v)
+
+
 def test_mixed_load_rung_fairness_under_flood():
     """Adversarial mix (the benchmarks/fairness.py shape, deterministic):
     a sustained easy flood plus one unreachable-hard job. Round-robin rung

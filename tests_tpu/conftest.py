@@ -59,13 +59,9 @@ def pytest_collection_modifyitems(config, items):
         # that re-pays cold compiles may never fit inside one. The
         # cache-reload test is unaffected (its subprocesses point at their
         # own tmp dir).
-        from tpu_dpow.utils import (
-            default_compilation_cache_dir,
-            enable_compilation_cache,
-        )
+        from tpu_dpow.utils import enable_default_compilation_cache
 
-        enable_compilation_cache(default_compilation_cache_dir(),
-                                 min_compile_secs=0.5)
+        enable_default_compilation_cache()
         return
     skip = pytest.mark.skip(reason=f"no TPU reachable (probe: {_platform})")
     for item in items:

@@ -35,6 +35,17 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", value)
 
 
+def _shared_compilation_cache_path() -> str:
+    """The shared cache path as a pure computation — no mkdir, no
+    validation. Exists so opt-out logic can RECOGNIZE the shared dir
+    without creating one (the validating helper below falls back to a
+    fresh tempdir when ~/.cache is unusable, so calling it from a
+    comparison both leaks a tempdir and never matches)."""
+    import os
+
+    return os.path.join(os.path.expanduser("~"), ".cache", "tpu_dpow", "jax_cache")
+
+
 def default_compilation_cache_dir() -> str:
     """Per-user persistent compile-cache path shared by bench.py and the
     tunnel watcher.
@@ -49,9 +60,7 @@ def default_compilation_cache_dir() -> str:
     import stat
     import tempfile
 
-    path = os.path.join(
-        os.path.expanduser("~"), ".cache", "tpu_dpow", "jax_cache"
-    )
+    path = _shared_compilation_cache_path()
     try:
         os.makedirs(path, mode=0o700, exist_ok=True)
         st = os.stat(path)
@@ -62,6 +71,53 @@ def default_compilation_cache_dir() -> str:
     except OSError:
         pass
     return tempfile.mkdtemp(prefix="tpu_dpow_jax_cache_")
+
+
+def enable_default_compilation_cache(*, min_compile_secs: float = 0.5) -> None:
+    """Point jax at the shared per-user compile cache — without importing jax.
+
+    The single opt-in point for bench.py, the bench bootstrap, and the
+    on-chip test suite (three hand-rolled copies drifted apart once
+    already): honors ``TPU_DPOW_NO_COMPILE_CACHE=1`` (compile-behavior
+    experiments, e.g. trace_cost.py, must measure real Mosaic compiles,
+    not cache loads), and configures via jax's env-var-backed config knobs
+    so pure-host processes (broker bench, the capture driver) never pay
+    the jax import, while child processes inherit the setting for free.
+    If jax is somehow already imported, falls through to the in-process
+    config update so the setting still takes effect this process.
+    """
+    import os
+    import sys
+
+    shared = _shared_compilation_cache_path()
+    if os.environ.get("TPU_DPOW_NO_COMPILE_CACHE", "") not in ("", "0"):
+        # The opt-out must hold even under a parent that already wired the
+        # cache into the inherited env (the env-var knobs are the whole
+        # mechanism) — but only undo OUR shared dir, never a deliberately
+        # custom one. Same for a process whose jax already latched the
+        # shared dir: clear the live config too, or it keeps caching.
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR") == shared:
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        if "jax" in sys.modules:
+            import jax
+
+            if jax.config.jax_compilation_cache_dir == shared:
+                jax.config.update("jax_compilation_cache_dir", None)
+        return
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", default_compilation_cache_dir())
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", str(min_compile_secs)
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES", "all")
+    if "jax" in sys.modules:
+        # Apply what the env actually says (setdefault may have kept a
+        # deliberately custom dir or threshold), not our own defaults.
+        enable_compilation_cache(
+            os.environ["JAX_COMPILATION_CACHE_DIR"],
+            min_compile_secs=float(
+                os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]
+            ),
+        )
 
 
 def enable_compilation_cache(path: str, *, min_compile_secs: float = 1.0) -> None:
