@@ -71,6 +71,135 @@ AXON_SITE = "/root/.axon_site"
 MAX_STEP_ATTEMPTS = 2
 
 
+def foreign_bench_pid():
+    """Pid of a live DRIVER-invoked bench.py, or None.
+
+    The chip is single-client and the watcher outlives the builder session,
+    so the driver's official round-end bench.py can collide with a detached
+    capture and fail with UNAVAILABLE — the exact artifact failure rounds
+    1–3 recorded. Bare bench runs announce themselves via a pid flag
+    (bench.py _announce_foreign_bench); a stale flag is removed.
+
+    Staleness check is identity-based where possible: the driver's hard
+    timeout SIGKILLs bench.py (no atexit), and a bare os.kill(pid, 0) on a
+    recycled pid pointing at some long-lived daemon would park the watcher
+    for hours — so on Linux the flag only counts while /proc/<pid>/cmdline
+    still looks like a bench invocation.
+    """
+    from tpu_dpow.utils import foreign_bench_flag_path
+
+    path = foreign_bench_flag_path()
+    try:
+        with open(path) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+    alive = False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            alive = b"bench" in f.read()
+    except OSError:
+        if not os.path.isdir("/proc"):  # non-Linux fallback: liveness only
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except OSError:
+                alive = False
+    if not alive:
+        _unlink_flag_if_still(path, pid)
+        return None
+    return pid
+
+
+def _unlink_flag_if_still(path: str, pid: int) -> None:
+    """Remove the flag only if it still names the pid we judged stale —
+    a fresh driver bench may have atomically replaced it between our read
+    and this unlink, and deleting ITS live flag would strip the driver of
+    the very protection this mechanism exists to provide."""
+    try:
+        with open(path) as f:
+            if int(f.read().strip()) == pid:
+                os.unlink(path)
+    except (OSError, ValueError):
+        pass
+
+
+def _kill_step_group(proc) -> None:
+    import signal as _signal
+
+    try:
+        os.killpg(proc.pid, _signal.SIGKILL)
+    except OSError:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def run_step(cmd, timeout: float, env: dict):
+    """Run one step, watching for a driver bench announcement mid-step.
+
+    The longest steps (1200 s) outlast the driver bench's entire retry
+    budget (~675 s), so a between-step gate alone would still let a
+    mid-step driver run fail every attempt with UNAVAILABLE. The step runs
+    in its own process group (its own children hold the chip) and is
+    killed the moment a foreign bench appears.
+
+    Returns (rc, stdout, stderr) where rc is the child's returncode,
+    "timeout", or "yielded".
+    """
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env, start_new_session=True,
+    )
+    deadline = time.time() + timeout
+    while True:
+        try:
+            out, err = proc.communicate(timeout=min(5.0, max(0.1, deadline - time.time())))
+            return proc.returncode, out, err
+        except subprocess.TimeoutExpired:
+            pass
+        if foreign_bench_pid() is not None:
+            _kill_step_group(proc)
+            out, err = proc.communicate()
+            return "yielded", out, err
+        if time.time() >= deadline:
+            _kill_step_group(proc)
+            out, err = proc.communicate()
+            return "timeout", out, err
+
+
+def wait_for_foreign_bench() -> None:
+    """Block (bounded) while a driver bench holds the chip.
+
+    The driver's worst case is ~12 min of attempts; the 30 min cap keeps a
+    wedged-but-alive foreign process from parking the capture forever.
+    A flag still live when the cap expires is treated as wedged and
+    force-cleared — otherwise the mid-step foreign check would kill the
+    very next step ~5 s in and the abort/resume cycle would loop forever,
+    defeating the cap. (A wedged bench is not measuring anything anyway.)
+    """
+    max_wait = float(os.environ.get("TPU_DPOW_FOREIGN_MAX_WAIT", 1800))
+    poll = min(10.0, max(0.1, max_wait / 4))
+    waited = 0.0
+    while waited < max_wait:
+        pid = foreign_bench_pid()
+        if pid is None:
+            return
+        print(f"yielding chip to driver bench.py (pid {pid}); waiting",
+              flush=True)
+        time.sleep(poll)
+        waited += poll
+    pid = foreign_bench_pid()
+    if pid is not None:
+        from tpu_dpow.utils import foreign_bench_flag_path
+
+        print(f"foreign bench.py (pid {pid}) exceeded the {max_wait:.0f}s "
+              "wait cap; treating it as wedged and clearing its flag",
+              flush=True)
+        _unlink_flag_if_still(foreign_bench_flag_path(), pid)
+
+
 def tunnel_alive(timeout: float | None = None) -> bool:
     """Bounded probe: is the TPU tunnel serving jits right now?
 
@@ -91,6 +220,14 @@ def tunnel_alive(timeout: float | None = None) -> bool:
         # Pinned to CPU (the test env): a TPU probe cannot succeed, and
         # with the plugin dir on PYTHONPATH during an outage it would just
         # block for the full timeout first.
+        return False
+    pid = foreign_bench_pid()
+    if pid is not None:
+        # A driver bench holds the single-client chip: probing now would
+        # contend with the round's official artifact. Report "not alive" so
+        # the watcher sleeps and retries after the driver is done.
+        print(f"yielding chip to driver bench.py (pid {pid}); probe deferred",
+              flush=True)
         return False
     if timeout is None:
         timeout = float(env.get("PROBE_TIMEOUT", 75))
@@ -216,14 +353,18 @@ def main() -> int:
             print(f"== {name}: fresh (rc 0, mark {args.mark!r}), skipping",
                   flush=True)
             continue
+        wait_for_foreign_bench()
         print(f"== {name}: {' '.join(cmd)}", flush=True)
         t0 = time.time()
-        try:
-            proc = subprocess.run(
-                cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout
-            )
-            tail = (proc.stdout or "").strip().splitlines()
-            record = {"rc": proc.returncode, "seconds": round(time.time() - t0, 1)}
+        # The env marker tells bench.py children they are part of this
+        # capture (no foreign-bench announcement) — a capture must not
+        # yield to itself.
+        child_env = dict(os.environ)
+        child_env["TPU_DPOW_EVIDENCE_CAPTURE"] = "1"
+        rc, out, err = run_step(cmd, timeout, child_env)
+        record = {"rc": rc, "seconds": round(time.time() - t0, 1)}
+        if rc not in ("timeout", "yielded"):
+            tail = (out or "").strip().splitlines()
             # keep the last JSON line if any step prints one
             for line in reversed(tail):
                 try:
@@ -233,24 +374,24 @@ def main() -> int:
                     continue
             if "result" not in record and tail:
                 record["tail"] = tail[-3:]
-            if proc.returncode != 0:
-                record["stderr_tail"] = (proc.stderr or "").strip().splitlines()[-3:]
-        except subprocess.TimeoutExpired:
-            record = {"rc": "timeout", "seconds": round(time.time() - t0, 1)}
+            if rc != 0:
+                record["stderr_tail"] = (err or "").strip().splitlines()[-3:]
         if args.mark:
             # Namespaced under a fixed key: a free-form value must not be
             # able to collide with (and overwrite) the reserved record keys
             # rc/seconds/result/tail/stderr_tail.
             record["mark"] = args.mark
         failed = record["rc"] != 0
-        tunnel_died = (failed and not args.no_dead_tunnel_abort
+        yielded = record["rc"] == "yielded"
+        tunnel_died = (failed and not yielded and not args.no_dead_tunnel_abort
                        and not tunnel_alive())
         if prior_marked:
-            if tunnel_died:
-                # A failure the probe attributes to the tunnel dying must
-                # not consume the retry budget: with ~2-min live windows
-                # and 900 s step timeouts, two outage-killed runs would
-                # otherwise permanently skip the step via the retry cap.
+            if tunnel_died or yielded:
+                # A failure the probe attributes to the tunnel dying — or a
+                # step killed to yield the chip to the driver — must not
+                # consume the retry budget: with ~2-min live windows and
+                # 900 s step timeouts, two such kills would otherwise
+                # permanently skip the step via the retry cap.
                 if "attempts" in prior:
                     record["attempts"] = prior["attempts"]
             else:
@@ -258,6 +399,13 @@ def main() -> int:
         results[name] = record
         save(results)  # progressive: a dead tunnel still leaves earlier steps
         print(f"   -> {json.dumps(record)[:240]}", flush=True)
+        if yielded:
+            results["capture_yielded_to_driver_unix"] = round(time.time(), 1)
+            save(results)
+            print(f"!! step {name} killed to yield the chip to a driver "
+                  "bench.py; aborting so the watcher resumes after it",
+                  flush=True)
+            return 3
         if tunnel_died:
             results["capture_aborted_dead_tunnel_unix"] = round(time.time(), 1)
             save(results)
@@ -266,6 +414,7 @@ def main() -> int:
                   "window", flush=True)
             return 3
     results.pop("capture_aborted_dead_tunnel_unix", None)
+    results.pop("capture_yielded_to_driver_unix", None)
     results["capture_finished_unix"] = round(time.time(), 1)
     save(results)
     return 0

@@ -776,6 +776,14 @@ def test_enable_default_compilation_cache_env_contract(monkeypatch):
         enable_default_compilation_cache(min_compile_secs=0.5)
         assert os.environ["JAX_COMPILATION_CACHE_DIR"] == "/custom/dir"
         assert jax.config.jax_compilation_cache_dir == "/custom/dir"
+
+        # The opt-out also recognizes the private-tempdir FALLBACK form
+        # the helper wires up when ~/.cache is unusable.
+        monkeypatch.setenv("TPU_DPOW_NO_COMPILE_CACHE", "1")
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/tpu_dpow_jax_cache_abc123")
+        enable_default_compilation_cache()
+        assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
     finally:
         # The helper writes env directly (monkeypatch only tracks vars it
         # touched itself), so drop whatever this test's calls left behind;

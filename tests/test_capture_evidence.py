@@ -15,7 +15,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "benchmarks", "capture_evidence.py")
 
 
-def run_capture(tmp_path, steps, argv_extra, out_name="bench.json", prior=None):
+def run_capture(tmp_path, steps, argv_extra, out_name="bench.json", prior=None,
+                env_extra=None):
     out = tmp_path / out_name
     if prior is not None:
         out.write_text(json.dumps(prior))
@@ -23,6 +24,7 @@ def run_capture(tmp_path, steps, argv_extra, out_name="bench.json", prior=None):
     steps_file.write_text(json.dumps(steps))
     env = dict(os.environ)
     env["TPU_DPOW_BENCH_OUT"] = str(out)
+    env.update(env_extra or {})
     # The dead-tunnel probe must see a CPU-only jax quickly, not block on a
     # half-up accelerator plugin: strip any plugin dirs from PYTHONPATH and
     # force the CPU platform (same rationale as tests/conftest.py).
@@ -164,6 +166,131 @@ def test_validate_catches_typod_step_name(tmp_path):
         [sys.executable, SCRIPT, "--steps", "headlne", "--validate"],
         capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
     assert bad.returncode == 2 and "headlne" in bad.stderr
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def standin_bench():
+    """A live process whose cmdline looks like a bench.py invocation (the
+    foreign-pid liveness check is identity-based via /proc cmdline)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)", "bench.py"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        yield proc
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_capture_yields_to_live_foreign_bench_then_proceeds(tmp_path):
+    # The driver's official bench.py announces itself via a pid flag; the
+    # capture must wait (bounded) rather than contend for the
+    # single-client chip. Tiny max-wait: the capture logs the yield, times
+    # the wait out, and still completes.
+    flag = tmp_path / "foreign.pid"
+    with standin_bench() as foreign:
+        flag.write_text(str(foreign.pid))
+        env_extra = {"TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag),
+                     "TPU_DPOW_FOREIGN_MAX_WAIT": "1"}
+        proc, data = run_capture(
+            tmp_path, [ok_step("a")], ["--mark", "t1"], env_extra=env_extra)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "yielding chip to driver bench.py" in proc.stdout
+    assert data["a"]["rc"] == 0
+
+
+def test_midstep_foreign_bench_kills_step_and_aborts_for_resume(tmp_path):
+    # The driver's whole retry budget (~675 s) is SHORTER than the longest
+    # step timeouts, so a between-step gate is not enough: a step must die
+    # the moment a driver bench appears mid-run, without consuming the
+    # step's retry budget.
+    flag = tmp_path / "foreign.pid"
+    slow = ["slow", [sys.executable, "-c", "import time; time.sleep(60)"], 90]
+    out = tmp_path / "bench.json"
+    steps_file = tmp_path / "steps.json"
+    steps_file.write_text(json.dumps([slow]))
+    env = dict(os.environ)
+    env.update({"TPU_DPOW_BENCH_OUT": str(out), "PYTHONPATH": REPO,
+                "JAX_PLATFORMS": "cpu",
+                "TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag)})
+    with standin_bench() as foreign:
+        proc = subprocess.Popen(
+            [sys.executable, SCRIPT, "--steps_file", str(steps_file),
+             "--mark", "t1"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO)
+        import time as _time
+
+        _time.sleep(8)  # let the capture enter the slow step
+        flag.write_text(str(foreign.pid))
+        stdout, stderr = proc.communicate(timeout=60)
+    data = json.loads(out.read_text())
+    assert proc.returncode == 3, (stdout, stderr)
+    assert "killed to yield" in stdout
+    assert data["slow"]["rc"] == "yielded"
+    assert data["slow"]["seconds"] < 60  # killed, not run to completion
+    assert "attempts" not in data["slow"]  # yield never consumes the budget
+    assert "capture_yielded_to_driver_unix" in data
+
+
+def test_wedged_foreign_bench_flag_force_cleared_after_wait_cap(tmp_path):
+    # A wedged-but-alive foreign bench must not park the capture forever:
+    # once the wait cap expires its flag is force-cleared, so the mid-step
+    # foreign check cannot kill the very next step and loop the abort
+    # cycle (a real driver bench finishes well inside the cap).
+    flag = tmp_path / "foreign.pid"
+    with standin_bench() as foreign:
+        flag.write_text(str(foreign.pid))
+        env_extra = {"TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag),
+                     "TPU_DPOW_FOREIGN_MAX_WAIT": "1"}
+        proc, data = run_capture(
+            tmp_path, [ok_step("a")], ["--mark", "t1"], env_extra=env_extra)
+        assert not flag.exists()  # cleared while the wedged process lives
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "treating it as wedged" in proc.stdout
+    assert data["a"]["rc"] == 0
+
+
+def test_stale_foreign_bench_flag_is_removed_and_ignored(tmp_path):
+    # A flag left by a SIGKILLed bench (dead or recycled pid — cmdline no
+    # longer a bench invocation) must not stall anything. The stand-in is
+    # alive but deliberately bench-free on its cmdline.
+    flag = tmp_path / "foreign.pid"
+    recycled = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        flag.write_text(str(recycled.pid))
+        env_extra = {"TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag)}
+        proc, data = run_capture(
+            tmp_path, [ok_step("a")], ["--mark", "t1"], env_extra=env_extra)
+    finally:
+        recycled.kill()
+        recycled.wait()
+    assert proc.returncode == 0, proc.stderr
+    assert "yielding" not in proc.stdout
+    assert data["a"]["rc"] == 0
+    assert not flag.exists()
+
+
+def test_bench_announces_and_clears_foreign_flag(tmp_path, monkeypatch):
+    import bench
+
+    flag = tmp_path / "foreign.pid"
+    monkeypatch.setenv("TPU_DPOW_FOREIGN_BENCH_FLAG", str(flag))
+    monkeypatch.delenv("TPU_DPOW_EVIDENCE_CAPTURE", raising=False)
+    bench._announce_foreign_bench()
+    assert flag.read_text() == str(os.getpid())
+    bench._clear_foreign_bench()
+    assert not flag.exists()
+
+    # Capture-spawned bench runs must NOT announce: they are the capture.
+    monkeypatch.setenv("TPU_DPOW_EVIDENCE_CAPTURE", "1")
+    bench._announce_foreign_bench()
+    assert not flag.exists()
 
 
 def test_no_dead_tunnel_abort_flag_keeps_going(tmp_path):

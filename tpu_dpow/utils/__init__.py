@@ -73,6 +73,21 @@ def default_compilation_cache_dir() -> str:
     return tempfile.mkdtemp(prefix="tpu_dpow_jax_cache_")
 
 
+def foreign_bench_flag_path() -> str:
+    """Where a bare (driver-invoked) bench.py announces its pid.
+
+    Single definition for the writer (bench.py) and the readers
+    (benchmarks/capture_evidence.py, via it the watcher): the chip is
+    single-client, so the detached evidence capture must yield while the
+    driver's official round-end bench holds it. Env-overridable for tests.
+    """
+    import os
+
+    return os.environ.get(
+        "TPU_DPOW_FOREIGN_BENCH_FLAG", "/tmp/tpu_dpow_foreign_bench.pid"
+    )
+
+
 def enable_default_compilation_cache(*, min_compile_secs: float = 0.5) -> None:
     """Point jax at the shared per-user compile cache — without importing jax.
 
@@ -89,22 +104,35 @@ def enable_default_compilation_cache(*, min_compile_secs: float = 0.5) -> None:
     import os
     import sys
 
-    shared = _shared_compilation_cache_path()
+    def ours(path) -> bool:
+        # Recognize both forms this helper wires up: the ideal shared path
+        # and the private-tempdir fallback default_compilation_cache_dir()
+        # returns when ~/.cache is unusable. A deliberately custom dir
+        # matches neither and is always respected.
+        return path is not None and (
+            path == _shared_compilation_cache_path()
+            or os.path.basename(path).startswith("tpu_dpow_jax_cache_")
+        )
+
     if os.environ.get("TPU_DPOW_NO_COMPILE_CACHE", "") not in ("", "0"):
         # The opt-out must hold even under a parent that already wired the
         # cache into the inherited env (the env-var knobs are the whole
-        # mechanism) — but only undo OUR shared dir, never a deliberately
-        # custom one. Same for a process whose jax already latched the
-        # shared dir: clear the live config too, or it keeps caching.
-        if os.environ.get("JAX_COMPILATION_CACHE_DIR") == shared:
+        # mechanism) — but only undo OUR dirs, never a deliberately custom
+        # one. Same for a process whose jax already latched our dir: clear
+        # the live config too, or it keeps caching.
+        if ours(os.environ.get("JAX_COMPILATION_CACHE_DIR")):
             os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
         if "jax" in sys.modules:
             import jax
 
-            if jax.config.jax_compilation_cache_dir == shared:
+            if ours(jax.config.jax_compilation_cache_dir):
                 jax.config.update("jax_compilation_cache_dir", None)
         return
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", default_compilation_cache_dir())
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        # Lazy on purpose: the validating helper creates directories (and
+        # falls back to a fresh mkdtemp when ~/.cache is unusable) — it
+        # must not run, or leak tempdirs, when a dir is already wired up.
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = default_compilation_cache_dir()
     os.environ.setdefault(
         "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", str(min_compile_secs)
     )
