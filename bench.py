@@ -56,42 +56,21 @@ _best_result = None  # best measurement so far (any platform), for SIGTERM
 # evidence watcher (benchmarks/watch_and_capture.sh) outlives the builder
 # session — so the driver's official bench.py run could land while a
 # detached capture holds the chip and fail every attempt. A bare bench
-# invocation therefore announces itself via this pid flag; the watcher's
-# probe and the capture's between-step gate yield while it is alive.
+# invocation therefore announces itself (shared helpers in tpu_dpow.utils;
+# the __graft_entry__ compile check announces the same way); the watcher's
+# probe and the capture's gates yield while the announcer lives.
 # Capture-spawned bench runs (TPU_DPOW_EVIDENCE_CAPTURE set) skip the
 # announcement — they ARE the capture.
-def _foreign_bench_flag_path() -> str:
-    from tpu_dpow.utils import foreign_bench_flag_path
+def _announce_foreign_bench() -> None:
+    from tpu_dpow.utils import announce_foreign_chip_user
 
-    return foreign_bench_flag_path()
+    announce_foreign_chip_user()
 
 
 def _clear_foreign_bench() -> None:
-    try:
-        with open(_foreign_bench_flag_path()) as f:
-            pid = int(f.read().strip())
-        if pid == os.getpid():
-            os.unlink(_foreign_bench_flag_path())
-    except (OSError, ValueError):
-        pass
+    from tpu_dpow.utils import clear_foreign_chip_user
 
-
-def _announce_foreign_bench() -> None:
-    if os.environ.get("TPU_DPOW_EVIDENCE_CAPTURE"):
-        return
-    path = _foreign_bench_flag_path()
-    try:
-        # Atomic: a reader must never see a truncated/empty flag and
-        # conclude "no driver bench" at exactly the moment one starts.
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.write(str(os.getpid()))
-        os.replace(tmp, path)
-    except OSError:
-        return
-    import atexit
-
-    atexit.register(_clear_foreign_bench)
+    clear_foreign_chip_user()
 
 
 def measure(reps: int = 8) -> dict:

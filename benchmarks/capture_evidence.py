@@ -72,39 +72,41 @@ MAX_STEP_ATTEMPTS = 2
 
 
 def foreign_bench_pid():
-    """Pid of a live DRIVER-invoked bench.py, or None.
+    """Pid of a live DRIVER-invoked chip user (bench.py or the
+    __graft_entry__ compile check), or None.
 
     The chip is single-client and the watcher outlives the builder session,
-    so the driver's official round-end bench.py can collide with a detached
+    so the driver's official round-end runs can collide with a detached
     capture and fail with UNAVAILABLE — the exact artifact failure rounds
-    1–3 recorded. Bare bench runs announce themselves via a pid flag
-    (bench.py _announce_foreign_bench); a stale flag is removed.
+    1–3 recorded. Driver-invoked chip users announce themselves via a
+    "pid start-time" flag (tpu_dpow.utils.announce_foreign_chip_user);
+    a stale flag is removed.
 
-    Staleness check is identity-based where possible: the driver's hard
-    timeout SIGKILLs bench.py (no atexit), and a bare os.kill(pid, 0) on a
-    recycled pid pointing at some long-lived daemon would park the watcher
-    for hours — so on Linux the flag only counts while /proc/<pid>/cmdline
-    still looks like a bench invocation.
+    Staleness is identity-based: the driver's hard timeout SIGKILLs its
+    children (no atexit), and a bare liveness check on a recycled pid
+    pointing at some long-lived daemon would park the watcher for hours —
+    the kernel start-time recorded in the flag identifies the announcing
+    process exactly. A pid-only flag (non-Linux writer) degrades to a
+    liveness check.
     """
-    from tpu_dpow.utils import foreign_bench_flag_path
+    from tpu_dpow.utils import foreign_bench_flag_path, process_start_time
 
     path = foreign_bench_flag_path()
     try:
         with open(path) as f:
-            pid = int(f.read().strip())
-    except (OSError, ValueError):
+            parts = f.read().split()
+        pid = int(parts[0])
+        flag_start = parts[1] if len(parts) > 1 else None
+    except (OSError, ValueError, IndexError):
         return None
-    alive = False
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            alive = b"bench" in f.read()
-    except OSError:
-        if not os.path.isdir("/proc"):  # non-Linux fallback: liveness only
-            try:
-                os.kill(pid, 0)
-                alive = True
-            except OSError:
-                alive = False
+    if flag_start is not None:
+        alive = process_start_time(pid) == flag_start
+    else:
+        try:
+            os.kill(pid, 0)
+            alive = True
+        except OSError:
+            alive = False
     if not alive:
         _unlink_flag_if_still(path, pid)
         return None
@@ -118,9 +120,9 @@ def _unlink_flag_if_still(path: str, pid: int) -> None:
     the very protection this mechanism exists to provide."""
     try:
         with open(path) as f:
-            if int(f.read().strip()) == pid:
+            if int(f.read().split()[0]) == pid:
                 os.unlink(path)
-    except (OSError, ValueError):
+    except (OSError, ValueError, IndexError):
         pass
 
 

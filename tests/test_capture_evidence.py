@@ -170,16 +170,23 @@ def test_validate_catches_typod_step_name(tmp_path):
 
 import contextlib
 
+sys.path.insert(0, REPO)
+from tpu_dpow.utils import process_start_time  # noqa: E402
+
 
 @contextlib.contextmanager
 def standin_bench():
-    """A live process whose cmdline looks like a bench.py invocation (the
-    foreign-pid liveness check is identity-based via /proc cmdline)."""
+    """A live stand-in for a driver-invoked chip user. Yields the flag
+    CONTENT alongside the process — "pid start-time" where the kernel
+    exposes start times, a bare pid elsewhere (mirroring
+    announce_foreign_chip_user, so the tests exercise whichever identity
+    form this host would really produce)."""
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import time; time.sleep(120)", "bench.py"],
+        [sys.executable, "-c", "import time; time.sleep(120)"],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    start = process_start_time(proc.pid)
     try:
-        yield proc
+        yield proc, f"{proc.pid} {start}" if start is not None else str(proc.pid)
     finally:
         proc.kill()
         proc.wait()
@@ -191,8 +198,8 @@ def test_capture_yields_to_live_foreign_bench_then_proceeds(tmp_path):
     # single-client chip. Tiny max-wait: the capture logs the yield, times
     # the wait out, and still completes.
     flag = tmp_path / "foreign.pid"
-    with standin_bench() as foreign:
-        flag.write_text(str(foreign.pid))
+    with standin_bench() as (_, identity):
+        flag.write_text(identity)
         env_extra = {"TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag),
                      "TPU_DPOW_FOREIGN_MAX_WAIT": "1"}
         proc, data = run_capture(
@@ -216,7 +223,7 @@ def test_midstep_foreign_bench_kills_step_and_aborts_for_resume(tmp_path):
     env.update({"TPU_DPOW_BENCH_OUT": str(out), "PYTHONPATH": REPO,
                 "JAX_PLATFORMS": "cpu",
                 "TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag)})
-    with standin_bench() as foreign:
+    with standin_bench() as (_, identity):
         proc = subprocess.Popen(
             [sys.executable, SCRIPT, "--steps_file", str(steps_file),
              "--mark", "t1"],
@@ -225,7 +232,7 @@ def test_midstep_foreign_bench_kills_step_and_aborts_for_resume(tmp_path):
         import time as _time
 
         _time.sleep(8)  # let the capture enter the slow step
-        flag.write_text(str(foreign.pid))
+        flag.write_text(identity)
         stdout, stderr = proc.communicate(timeout=60)
     data = json.loads(out.read_text())
     assert proc.returncode == 3, (stdout, stderr)
@@ -242,8 +249,8 @@ def test_wedged_foreign_bench_flag_force_cleared_after_wait_cap(tmp_path):
     # foreign check cannot kill the very next step and loop the abort
     # cycle (a real driver bench finishes well inside the cap).
     flag = tmp_path / "foreign.pid"
-    with standin_bench() as foreign:
-        flag.write_text(str(foreign.pid))
+    with standin_bench() as (_, identity):
+        flag.write_text(identity)
         env_extra = {"TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag),
                      "TPU_DPOW_FOREIGN_MAX_WAIT": "1"}
         proc, data = run_capture(
@@ -254,22 +261,33 @@ def test_wedged_foreign_bench_flag_force_cleared_after_wait_cap(tmp_path):
     assert data["a"]["rc"] == 0
 
 
-def test_stale_foreign_bench_flag_is_removed_and_ignored(tmp_path):
-    # A flag left by a SIGKILLed bench (dead or recycled pid — cmdline no
-    # longer a bench invocation) must not stall anything. The stand-in is
-    # alive but deliberately bench-free on its cmdline.
-    flag = tmp_path / "foreign.pid"
-    recycled = subprocess.Popen(
-        [sys.executable, "-c", "import time; time.sleep(120)"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+def test_zombie_chip_user_reads_as_gone():
+    # A SIGKILLed-but-unreaped (zombie) chip user holds nothing; its
+    # /proc stat line still exists, so the identity helper must report it
+    # gone by state, not alive by start-time.
+    import time
+
+    proc = subprocess.Popen([sys.executable, "-c", "pass"],
+                            stdout=subprocess.DEVNULL)
     try:
-        flag.write_text(str(recycled.pid))
+        deadline = time.time() + 10
+        while process_start_time(proc.pid) is not None and time.time() < deadline:
+            time.sleep(0.05)
+        assert process_start_time(proc.pid) is None
+    finally:
+        proc.wait()
+
+
+def test_stale_foreign_bench_flag_is_removed_and_ignored(tmp_path):
+    # A flag left by a SIGKILLed chip user whose pid was RECYCLED must not
+    # stall anything: the pid below is alive, but its kernel start-time
+    # cannot match the (fabricated) one in the flag.
+    flag = tmp_path / "foreign.pid"
+    with standin_bench() as (proc_alive, _):
+        flag.write_text(f"{proc_alive.pid} 1")
         env_extra = {"TPU_DPOW_FOREIGN_BENCH_FLAG": str(flag)}
         proc, data = run_capture(
             tmp_path, [ok_step("a")], ["--mark", "t1"], env_extra=env_extra)
-    finally:
-        recycled.kill()
-        recycled.wait()
     assert proc.returncode == 0, proc.stderr
     assert "yielding" not in proc.stdout
     assert data["a"]["rc"] == 0
@@ -278,12 +296,15 @@ def test_stale_foreign_bench_flag_is_removed_and_ignored(tmp_path):
 
 def test_bench_announces_and_clears_foreign_flag(tmp_path, monkeypatch):
     import bench
+    from tpu_dpow.utils import process_start_time
 
     flag = tmp_path / "foreign.pid"
     monkeypatch.setenv("TPU_DPOW_FOREIGN_BENCH_FLAG", str(flag))
     monkeypatch.delenv("TPU_DPOW_EVIDENCE_CAPTURE", raising=False)
     bench._announce_foreign_bench()
-    assert flag.read_text() == str(os.getpid())
+    pid, start = flag.read_text().split()
+    assert pid == str(os.getpid())
+    assert start == process_start_time(os.getpid())  # exact identity
     bench._clear_foreign_bench()
     assert not flag.exists()
 

@@ -74,18 +74,81 @@ def default_compilation_cache_dir() -> str:
 
 
 def foreign_bench_flag_path() -> str:
-    """Where a bare (driver-invoked) bench.py announces its pid.
+    """Where a driver-invoked chip user (bench.py, the __graft_entry__
+    compile check) announces itself.
 
-    Single definition for the writer (bench.py) and the readers
+    Single definition for the writers and the readers
     (benchmarks/capture_evidence.py, via it the watcher): the chip is
     single-client, so the detached evidence capture must yield while the
-    driver's official round-end bench holds it. Env-overridable for tests.
+    driver's official round-end runs hold it. Env-overridable for tests.
     """
     import os
 
     return os.environ.get(
         "TPU_DPOW_FOREIGN_BENCH_FLAG", "/tmp/tpu_dpow_foreign_bench.pid"
     )
+
+
+def process_start_time(pid: int):
+    """The kernel's start-time ticks for ``pid`` (str), or None.
+
+    (pid, start-time) identifies a process exactly — unlike a bare pid,
+    which the kernel recycles, and unlike cmdline heuristics, which break
+    the moment a new kind of chip-holding harness appears. Field 22 of
+    /proc/<pid>/stat; the comm field may contain spaces/parens, so parse
+    from the LAST ')'. A zombie (state 'Z') reports None: a SIGKILLed
+    chip user awaiting its parent's reap holds nothing and must read as
+    gone, not alive (a live process asking about itself is never 'Z').
+    """
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(")", 1)[1].split()
+        if fields[0] == "Z":
+            return None
+        return fields[19]
+    except (OSError, IndexError):
+        return None
+
+
+def announce_foreign_chip_user() -> None:
+    """Atomically write this process's identity to the foreign-chip flag.
+
+    Called by every DRIVER-invoked process that will hold the single-client
+    chip (bench.py, __graft_entry__.entry) so the detached evidence
+    capture yields instead of colliding. No-op under an evidence capture
+    (TPU_DPOW_EVIDENCE_CAPTURE — the capture must not yield to itself) and
+    best-effort on any OS error: announcing must never break the caller,
+    whose output is the round's official artifact.
+    """
+    import atexit
+    import os
+
+    if os.environ.get("TPU_DPOW_EVIDENCE_CAPTURE"):
+        return
+    path = foreign_bench_flag_path()
+    me = os.getpid()
+    start = process_start_time(me)
+    try:
+        tmp = f"{path}.{me}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{me} {start}" if start is not None else str(me))
+        os.replace(tmp, path)
+    except OSError:
+        return
+    atexit.register(clear_foreign_chip_user)
+
+
+def clear_foreign_chip_user() -> None:
+    """Remove the foreign-chip flag iff it still names this process."""
+    import os
+
+    path = foreign_bench_flag_path()
+    try:
+        with open(path) as f:
+            if int(f.read().split()[0]) == os.getpid():
+                os.unlink(path)
+    except (OSError, ValueError, IndexError):
+        pass
 
 
 def enable_default_compilation_cache(*, min_compile_secs: float = 0.5) -> None:
