@@ -116,6 +116,56 @@ def test_handler_cancel_in_queue_vs_ongoing():
     run(main())
 
 
+def test_cancelled_job_cleanup_spares_successor_job():
+    """A cancel pops the ongoing entry, a re-enqueued duplicate starts on
+    another worker — and only THEN does the first worker's WorkCancelled
+    land. Its cleanup must not delete the successor's ongoing entry, or
+    the successor's eventual result is dropped as 'completed after
+    cancel' and the request strands until the server's republish heal."""
+
+    class DeferredCancelBackend(WorkBackend):
+        def __init__(self):
+            self.futures = {}  # bh -> [futures in generate order]
+
+        async def setup(self):
+            pass
+
+        async def generate(self, request):
+            fut = asyncio.get_running_loop().create_future()
+            self.futures.setdefault(request.block_hash, []).append(fut)
+            return await fut
+
+        async def cancel(self, block_hash):
+            pass  # cancellation lands later, driven by the test
+
+    async def main():
+        backend = DeferredCancelBackend()
+        results = []
+
+        async def cb(req, work):
+            results.append((req.block_hash, work))
+
+        handler = WorkHandler(backend, cb, concurrency=2)
+        await handler.start()
+        h = random_hash()
+        await handler.queue_work(WorkRequest(h, EASY))
+        await wait_until(lambda: h in backend.futures)
+        await handler.queue_cancel(h)  # pops ongoing; backend cancel deferred
+        await handler.queue_work(WorkRequest(h, EASY))  # successor job
+        await wait_until(lambda: len(backend.futures[h]) == 2)
+        # The OLD job's cancellation lands only now, after the successor
+        # occupies the hash.
+        backend.futures[h][0].set_exception(WorkCancelled(h))
+        await asyncio.sleep(0.05)
+        assert h in handler.ongoing  # successor survived the old cleanup
+        backend.futures[h][1].set_result("beef")
+        await wait_until(lambda: results)
+        assert results == [(h, "beef")]
+        await handler.stop()
+
+    run(main())
+
+
 def test_handler_completion_after_cancel_dropped():
     async def main():
         backend = ManualBackend()

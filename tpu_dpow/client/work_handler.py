@@ -83,6 +83,26 @@ class WorkQueue:
         return item
 
 
+class _OngoingJob:
+    """Mutable holder giving one worker-loop job a STABLE identity.
+
+    A raised duplicate relabels the job's request in place (same holder),
+    so every ongoing-map access can be identity-guarded against the holder
+    the worker installed. Guarding against the WorkRequest itself would
+    break one way or the other: requests are frozen (a relabel must swap
+    objects), and an unguarded pop in a worker's exception path can delete
+    a DIFFERENT worker's entry for the same hash — cancel pops the entry,
+    a re-enqueued duplicate starts on another worker, then the first
+    worker's WorkCancelled lands and would blow away the new job, whose
+    eventual result gets dropped as "completed after cancel".
+    """
+
+    __slots__ = ("request",)
+
+    def __init__(self, request: WorkRequest):
+        self.request = request
+
+
 class WorkHandler:
     def __init__(
         self,
@@ -95,7 +115,7 @@ class WorkHandler:
         self.result_callback = result_callback
         self.concurrency = concurrency
         self.queue = WorkQueue()
-        self.ongoing: Dict[str, WorkRequest] = {}
+        self.ongoing: Dict[str, _OngoingJob] = {}
         self._workers: list = []
         self._started = False
         self.stats = {"queued": 0, "deduped": 0, "solved": 0, "cancelled": 0, "errors": 0}
@@ -131,16 +151,15 @@ class WorkHandler:
         nano-work-server; a job that just finished at the weak target).
         """
         bh = request.block_hash
-        ongoing = self.ongoing.get(bh)
-        if ongoing is not None:
-            if request.difficulty > ongoing.difficulty:
+        job = self.ongoing.get(bh)
+        if job is not None:
+            if request.difficulty > job.request.difficulty:
                 if await self.backend.raise_difficulty(bh, request.difficulty):
                     # The await may have yielded; only relabel if the SAME
-                    # entry is still ongoing — writing after the worker loop
-                    # popped it would plant a ghost entry that dedups this
-                    # hash forever.
-                    if self.ongoing.get(bh) is ongoing:
-                        self.ongoing[bh] = request  # report under the raise
+                    # job is still ongoing — writing after the worker loop
+                    # popped it would mislabel a successor job.
+                    if self.ongoing.get(bh) is job:
+                        job.request = request  # report under the raise
                 else:
                     await self.queue_cancel(bh)
                     self.queue.put(request)
@@ -175,38 +194,47 @@ class WorkHandler:
             except Exception as e:
                 logger.warning("backend cancel failed for %s: %s", block_hash, e)
 
+    def _drop_own(self, bh: str, job: _OngoingJob) -> None:
+        """Remove OUR job's entry only: after a cancel popped it, a
+        re-enqueued duplicate may already be running on another worker
+        under the same hash — its entry is not ours to delete."""
+        if self.ongoing.get(bh) is job:
+            del self.ongoing[bh]
+
     async def _worker_loop(self) -> None:
         while True:
             request = await self.queue.pop_random()
             bh = request.block_hash
-            self.ongoing[bh] = request
+            job = _OngoingJob(request)
+            self.ongoing[bh] = job
             try:
                 work = await self.backend.generate(request)
             except WorkCancelled:
-                self.ongoing.pop(bh, None)
+                self._drop_own(bh, job)
                 continue
             except WorkError as e:
-                self.ongoing.pop(bh, None)
+                self._drop_own(bh, job)
                 self.stats["errors"] += 1
                 logger.error("work generation failed for %s: %s", bh, e)
                 continue
             except asyncio.CancelledError:
                 raise
             except Exception:
-                self.ongoing.pop(bh, None)
+                self._drop_own(bh, job)
                 self.stats["errors"] += 1
                 logger.error("unexpected backend failure:\n%s", traceback.format_exc())
                 continue
-            # Completion/cancel race: only report if still ongoing. The
-            # popped entry, not the popped-at-dispatch `request`, is what
-            # gets reported — a duplicate may have raised its difficulty
-            # while the job was in flight.
-            current = self.ongoing.pop(bh, None)
-            if current is None:
+            # Completion/cancel race: only report if OUR job is still the
+            # ongoing entry (a cancel may have popped it — and a successor
+            # may occupy the hash now). The job's CURRENT request, not the
+            # popped-at-dispatch one, is what gets reported — a duplicate
+            # may have raised its difficulty while the job was in flight.
+            if self.ongoing.get(bh) is not job:
                 logger.debug("work %s completed after cancel; dropped", bh)
                 continue
+            del self.ongoing[bh]
             self.stats["solved"] += 1
             try:
-                await self.result_callback(current, work)
+                await self.result_callback(job.request, work)
             except Exception:
                 logger.error("result callback failed:\n%s", traceback.format_exc())
