@@ -65,11 +65,14 @@ probe() {
     # uninterruptible tunnel call can shrug off the TERM (observed: a
     # half-up tunnel ate the TERM and the watcher sat 6+ min past its own
     # timeout); the probe's jax child is SIGKILLed by subprocess timeout.
-    # +120 headroom: the wrapper interpreter's own startup pays plugin
-    # registration over the tunnel (seconds-to-tens on a degraded link) and
-    # the inner layers already use up to PROBE_TIMEOUT+30; a tight outer
-    # bound would TERM a slow-but-live probe and misreport a real window.
-    timeout --kill-after=30 $(( PROBE_TIMEOUT + 120 )) \
+    # The wrapper runs WITHOUT the axon dir: during an outage the plugin's
+    # sitecustomize blocks interpreter startup on the tunnel, which would
+    # burn the full outer bound per probe cycle (observed: ~195 s/cycle
+    # instead of ~75 s). tunnel_alive() re-injects the plugin dir into its
+    # probe CHILD's env, which the inner timeout bounds properly. The outer
+    # timeout is pure backstop with headroom for the inner layers
+    # (PROBE_TIMEOUT + 30 kill-after + child startup).
+    PYTHONPATH="$REPO" timeout --kill-after=30 $(( PROBE_TIMEOUT + 120 )) \
         python benchmarks/capture_evidence.py --probe
 }
 
