@@ -18,12 +18,16 @@ def run(coro):
 
 
 class FakeNode:
-    """Minimal nano-node websocket: acks subscribes, replays a script."""
+    """Minimal nano-node websocket: acks subscribes, replays a script.
 
-    def __init__(self):
+    ``close_after_ack``: clean-close right after the subscribe ack — the
+    accept/ack/close node the reconnect backoff must survive."""
+
+    def __init__(self, close_after_ack: bool = False):
         self.server = None
         self.conns = 0
         self.script = []  # raw frames pushed to each new subscriber
+        self.close_after_ack = close_after_ack
         self._clients = set()
 
     async def start(self):
@@ -37,6 +41,8 @@ class FakeNode:
             sub = json.loads(await ws.recv())
             assert sub["action"] == "subscribe" and sub["topic"] == "confirmation"
             await ws.send(json.dumps({"ack": "subscribe"}))
+            if self.close_after_ack:
+                return  # handler return → clean close
             for frame in self.script:
                 await ws.send(frame)
             async for _ in ws:
@@ -134,6 +140,35 @@ def test_reconnects_after_drop_with_backoff():
             if got:
                 break
         assert got == ["CC" * 32]  # resubscribed and kept forwarding
+        await client.stop()
+        await node.stop()
+
+    run(main())
+
+
+def test_clean_close_reconnect_is_backed_off():
+    """A node that accepts, acks, and immediately CLEAN-closes must not
+    drive a hot reconnect loop — the clean-close path waits the same
+    backoff as the error path."""
+
+    async def main():
+        node = FakeNode(close_after_ack=True)
+        port = await node.start()
+        client = NanoWebsocketClient(f"ws://127.0.0.1:{port}", lambda m: None,
+                                     reconnect_interval=5.0)
+        client.start()
+        for _ in range(100):  # poll for the first connect (slow-CI-safe)
+            await asyncio.sleep(0.02)
+            if node.conns:
+                break
+        assert node.conns, "client never connected"
+        base = node.conns
+        await asyncio.sleep(1.2)
+        # Backoff starts at 1 s and DOUBLES (the ack must not reset it —
+        # only a live confirmation frame does): at most ~one retry lands in
+        # the window. A hot loop would rack up dozens.
+        assert node.conns - base <= 2, (
+            f"hot reconnect loop: {node.conns - base} reconnects in 1.2s")
         await client.stop()
         await node.stop()
 

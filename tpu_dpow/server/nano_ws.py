@@ -48,8 +48,12 @@ class NanoWebsocketClient:
             try:
                 async with websockets.connect(self.uri) as ws:
                     await self._subscribe(ws)
-                    delay = 1.0
                     async for raw in ws:
+                        # Reset backoff only once the FEED is proven live —
+                        # resetting after the subscribe ack would let a node
+                        # that accepts, acks, and immediately closes pin the
+                        # delay at its floor forever, never reaching the cap.
+                        delay = 1.0
                         # Message-level problems must not tear down a healthy
                         # socket (that loses every confirmation in the
                         # reconnect backoff window) — and a failing HANDLER
@@ -84,8 +88,12 @@ class NanoWebsocketClient:
                 logger.warning(
                     "node websocket dropped (%s); reconnecting in %.0fs", e, delay
                 )
-                await asyncio.sleep(delay)
-                delay = min(delay * 2, self.reconnect_interval)
+            else:
+                # Clean server-side close: without a pause here, a node that
+                # accepts + acks + closes would spin a hot reconnect loop.
+                logger.info("node websocket closed; reconnecting in %.0fs", delay)
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, self.reconnect_interval)
 
     def start(self) -> None:
         self._task = asyncio.ensure_future(self._run())
