@@ -215,7 +215,16 @@ def test_midstep_foreign_bench_kills_step_and_aborts_for_resume(tmp_path):
     # the moment a driver bench appears mid-run, without consuming the
     # step's retry budget.
     flag = tmp_path / "foreign.pid"
-    slow = ["slow", [sys.executable, "-c", "import time; time.sleep(60)"], 90]
+    started = tmp_path / "step_started"
+    # The step announces itself via a sentinel file so the test can write
+    # the foreign flag strictly AFTER the step is in flight — a fixed sleep
+    # here proved flaky under load (capture startup outran the sleep and
+    # the flag was treated as a pre-step foreign user, parking the capture
+    # in the wait path instead of the mid-step kill this test pins).
+    slow = ["slow", [sys.executable, "-c",
+                     "import pathlib, time; "
+                     f"pathlib.Path({str(started)!r}).write_text('x'); "
+                     "time.sleep(120)"], 150]
     out = tmp_path / "bench.json"
     steps_file = tmp_path / "steps.json"
     steps_file.write_text(json.dumps([slow]))
@@ -231,9 +240,18 @@ def test_midstep_foreign_bench_kills_step_and_aborts_for_resume(tmp_path):
             env=env, cwd=REPO)
         import time as _time
 
-        _time.sleep(8)  # let the capture enter the slow step
-        flag.write_text(identity)
-        stdout, stderr = proc.communicate(timeout=60)
+        try:
+            deadline = _time.monotonic() + 60
+            while not started.exists():
+                assert _time.monotonic() < deadline, "slow step never started"
+                assert proc.poll() is None, proc.communicate()
+                _time.sleep(0.2)
+            flag.write_text(identity)
+            stdout, stderr = proc.communicate(timeout=120)
+        except BaseException:
+            proc.kill()
+            proc.wait()
+            raise
     data = json.loads(out.read_text())
     assert proc.returncode == 3, (stdout, stderr)
     assert "killed to yield" in stdout
