@@ -100,9 +100,18 @@ def main() -> int:
 
     r = res(step("cancel"))
     if r:
-        # residue bound in ms: bound_windows * ~3.7 ms/window at flagship
-        # throughput, doubled for tunnel jitter.
-        bound_ms = r.get("bound_windows", 20) * 3.7 * 2
+        # Residue bound in ms: bound_windows of scan at flagship throughput
+        # (~3.7 ms/window) plus the launch round trips the drain inherently
+        # serializes — the run loop awaits the corpse launch's readback, and
+        # the probe's own launch pays one more. Price those at the SAME
+        # capture's measured padded-launch floor (overhead step) so a slow
+        # tunnel day widens the bound with the evidence in hand; fall back
+        # to doubling for jitter when no overhead record landed.
+        floor = res(step("overhead")).get("pad_batch16_8win_ms")
+        if floor:
+            bound_ms = r.get("bound_windows", 20) * 3.7 + 2 * floor
+        else:
+            bound_ms = r.get("bound_windows", 20) * 3.7 * 2
         row("cancel", r.get("added_p50_ms", 1e9) <= bound_ms,
             f"added_p50 {r.get('added_p50_ms')} ms vs ~{bound_ms:.0f} ms bound")
     else:

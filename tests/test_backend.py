@@ -579,8 +579,8 @@ async def _gated_recording_backend(**kw):
     real_dispatch = b._dispatch_next
     records = []
 
-    def recording(*args):
-        rec = real_dispatch(*args)
+    def recording(*args, **kwargs):
+        rec = real_dispatch(*args, **kwargs)
         if rec is not None:
             records.append([j.block_hash for j in rec.jobs])
         return rec
@@ -954,6 +954,79 @@ def test_speculative_successor_launch_is_narrow():
         assert launches[0] == 16, launches
         if len(launches) > 1:  # the job can solve before a successor runs
             assert launches[1] == 4, launches
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_fresh_head_full_width_behind_dead_launches():
+    """A launch whose every covered job was resolved or cancelled while it
+    was on the wire still occupies a pipeline slot — but it must not demote
+    the next arrival's head launch to successor width. The fresh arrival is
+    the effective head of the queue (nothing live executes in front of it),
+    and its full width is what solves it in one round trip instead of
+    chaining capped passes behind a corpse (measured on-chip r4: 83 ms p50
+    queue-wait tax on sequential traffic)."""
+    import threading
+
+    async def run():
+        b = make_backend(run_steps=16, pipeline=2)
+        b.record_timeline = True
+        await b.setup()
+        lock = threading.Lock()
+        gates = [threading.Event() for _ in range(8)]
+        launches = []
+        real_launch = b._launch
+
+        def gated(params, steps):
+            with lock:
+                gate = gates[len(launches)]
+                launches.append(steps)
+            if not gate.wait(timeout=10):
+                raise TimeoutError("per-launch gate never released in 10s")
+            return real_launch(params, steps)
+
+        b._launch = gated
+        try:
+            hard = random_hash()
+            t1 = asyncio.ensure_future(
+                b.generate(WorkRequest(hard, (1 << 64) - 2))
+            )
+            while len(launches) < 2:  # head + capped successor in flight
+                await asyncio.sleep(0.01)
+            assert launches == [16, 4], launches
+            # Both in-flight launches become corpses; the successor (gate
+            # 1) stays physically in flight across the next dispatch.
+            await b.cancel(hard)
+            with pytest.raises(WorkCancelled):
+                await t1
+            h2 = random_hash()
+            t2 = asyncio.ensure_future(
+                b.generate(WorkRequest(h2, (1 << 64) - 2))
+            )
+            gates[0].set()  # head returns; run loop refills the pipe
+            while len(launches) < 3:
+                await asyncio.sleep(0.01)
+            # Old policy: len(inflight)=1 (the corpse) -> capped 4. The
+            # corpse serves nothing, so the fresh head keeps full width.
+            assert launches[2] == 16, launches
+            await b.cancel(h2)
+            with pytest.raises(WorkCancelled):
+                await t2
+        finally:
+            for g in gates:
+                g.set()
+        # The timeline (stamped at result-apply, FIFO) must record the
+        # PHYSICAL queue depth: the overhead decomposition buckets
+        # head-vs-successor device time by what is actually in front on
+        # the device — the corpse counts, even though the width policy
+        # ignores it.
+        def stamped():
+            return [t["inflight"] for kind, t in b.timeline if kind == "launch"]
+
+        while len(stamped()) < 3:
+            await asyncio.sleep(0.01)
+        assert stamped()[2] == 1, stamped()
+        await b.close()
 
     asyncio.run(asyncio.wait_for(run(), 30))
 

@@ -84,6 +84,21 @@ def test_precache_gates_on_hit_latency_and_errors(tmp_path):
     assert rows["precache"][0] == "FAIL"
 
 
+def test_cancel_bound_prices_launch_floor_from_overhead_record(tmp_path):
+    # The drain serializes ~2 launch round trips, so the bound must widen
+    # with the SAME capture's measured padded-launch floor: 20*3.7 + 2*66
+    # ≈ 206 ms. Without an overhead record it falls back to doubling.
+    cancel = {"rc": 0, "result": {"added_p50_ms": 180.0, "bound_windows": 20}}
+    overhead = {"rc": 0, "result": {"pad_batch16_8win_ms": 66.0}}
+    _, rows = summarize(tmp_path, {"cancel": cancel, "overhead": overhead})
+    assert rows["cancel"][0] == "PASS" and "~206 ms bound" in rows["cancel"][1]
+    _, rows = summarize(tmp_path, {"cancel": cancel})  # fallback: 148 ms
+    assert rows["cancel"][0] == "FAIL" and "~148 ms bound" in rows["cancel"][1]
+    cancel["result"]["added_p50_ms"] = 361.8  # the pre-fix r4 on-chip value
+    _, rows = summarize(tmp_path, {"cancel": cancel, "overhead": overhead})
+    assert rows["cancel"][0] == "FAIL"
+
+
 def test_exit_code_reflects_failures(tmp_path):
     ok = {"flood": {"rc": 0, "result": {"req_per_sec": 15.0, "p50_ms": 900}}}
     proc, _ = summarize(tmp_path, ok)

@@ -452,30 +452,53 @@ def test_compilation_cache_reload_across_processes(tmp_path):
     import sys
 
     child = r"""
-import json, sys, time
+import json, os, sys, time
 from tpu_dpow.utils import enable_compilation_cache
 enable_compilation_cache(sys.argv[1], min_compile_secs=0.0)
-import numpy as np
+import jax, numpy as np
 from tpu_dpow.ops import pallas_kernel, search
+
+def entries():
+    return sorted(
+        os.path.join(d, f)
+        for d, _, fs in os.walk(sys.argv[1])
+        for f in fs
+    )
+
+# Pay device init (tunnel handshake, platform bring-up) OUTSIDE the timed
+# section: it is identical for both runs and does not shrink with a warm
+# cache, so including it let a slow tunnel mask a working reload (observed
+# on-chip: the 0.5x assertion failed with the reload functioning).
+t0 = time.perf_counter()
+jax.jit(lambda a: a + 1)(jax.numpy.ones((8,))).block_until_ready()
+init_s = time.perf_counter() - t0
+before = entries()
 params = np.stack([search.pack_params(bytes(32), 1, 0)])
 t0 = time.perf_counter()
 np.asarray(pallas_kernel.pallas_search_chunk_batch(
     params, sublanes=32, iters=1024, nblocks=2, group=8))
-print(json.dumps({"first_launch_s": time.perf_counter() - t0}))
+print(json.dumps({"init_s": init_s,
+                  "first_launch_s": time.perf_counter() - t0,
+                  "kernel_entries": len(entries()) - len(before)}))
 """
-    times = []
+    runs = []
     for _ in range(2):
         proc = subprocess.run(
             [sys.executable, "-c", child, str(tmp_path)],
             capture_output=True, text=True, timeout=600,
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
-        times.append(json.loads(proc.stdout.strip().splitlines()[-1])["first_launch_s"])
-    if not any(tmp_path.iterdir()):
-        # Backend cannot serialize executables (enable_compilation_cache is
-        # documented best-effort) — nothing to reload, so a no-speedup run
-        # is expected, not a regression. Surface as a skip with the data.
-        pytest.skip(f"no cache entries written on this backend; times={times}")
-    # Run 2 skips the XLA compile: allow generous tunnel jitter, but a
-    # reload must beat a fresh compile by a wide margin.
-    assert times[1] < max(0.5 * times[0], 5.0), times
+        runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    times = [r["first_launch_s"] for r in runs]
+    if times[1] < max(0.5 * times[0], 5.0):
+        return  # reload beat a fresh compile by a wide margin
+    # No speedup. Distinguish "backend cannot serialize the kernel
+    # executable" (documented best-effort: skip, with the data) from a
+    # genuine reload regression: run 1 reports whether the kernel launch
+    # itself wrote cache entries (counted by the child AFTER the warm-up
+    # jit, so the trivial executable's entry cannot be mistaken for the
+    # kernel's).
+    if runs[0]["kernel_entries"] == 0:
+        pytest.skip(
+            f"kernel executable not serialized on this backend; runs={runs}")
+    assert False, f"cache reload gave no speedup: runs={runs}"

@@ -246,9 +246,12 @@ class JaxWorkBackend(WorkBackend):
         # cancel-in-flight race. Successor launches prefer UNCOVERED demand
         # over re-scanning jobs already likely solved in flight
         # (_dispatch_next's coverage accounting). Worst-case wait behind
-        # in-flight work is bounded by run_steps + (pipeline-1) *
+        # LIVE in-flight work is bounded by run_steps + (pipeline-1) *
         # shared_steps_cap windows: only the head-of-queue launch may run
-        # full width (_dispatch_next's successor cap).
+        # full width (_dispatch_next's successor cap, which counts only
+        # launches still serving an unresolved job — a transient corpse
+        # launch can add up to run_steps more, bounded by its own already-
+        # running scan).
         self.pipeline = max(1, pipeline)
         if step_ladder not in ("x4", "x2"):
             raise WorkError(f"step_ladder must be 'x4' or 'x2', not {step_ladder!r}")
@@ -679,7 +682,9 @@ class JaxWorkBackend(WorkBackend):
         """
         return max(math.exp(-span * cls._solve_p(difficulty)), 1e-12)
 
-    def _dispatch_next(self, inflight: int = 0) -> "Optional[_Launch]":
+    def _dispatch_next(
+        self, inflight: int = 0, physical_inflight: Optional[int] = None
+    ) -> "Optional[_Launch]":
         """Pack and submit one launch for the next difficulty rung, or None
         when nothing is worth dispatching.
 
@@ -765,7 +770,16 @@ class JaxWorkBackend(WorkBackend):
         factors = [self._miss_factor(j.difficulty, span) for j in active]
         timing = None
         if self.record_timeline:
-            timing = {"t_dispatch": time.perf_counter(), "inflight": inflight}
+            # Timeline stamps the PHYSICAL queue depth: the overhead
+            # decomposition buckets head-vs-successor device time by
+            # "nothing in front of it on the device", which a corpse launch
+            # still is — only the WIDTH policy treats corpses as absent.
+            timing = {
+                "t_dispatch": time.perf_counter(),
+                "inflight": (
+                    inflight if physical_inflight is None else physical_inflight
+                ),
+            }
             for j in active:
                 if not j.t_first_dispatch:
                     j.t_first_dispatch = timing["t_dispatch"]
@@ -863,7 +877,23 @@ class JaxWorkBackend(WorkBackend):
             # Keep up to ``pipeline`` launches in flight: the device starts
             # on launch N+1 while launch N's results are still in transit.
             while len(inflight) < self.pipeline:
-                rec = self._dispatch_next(len(inflight))
+                # Width policy counts only LIVE in-flight launches (still
+                # serving an unresolved, uncancelled job). A dying launch —
+                # every covered job solved or cancelled while it was on the
+                # wire — occupies a pipeline slot but must not demote the
+                # next launch to successor width: that launch is the
+                # effective head for the fresh demand it serves, and its
+                # full width is what makes a sequential arrival solve in
+                # one round trip instead of chaining capped passes behind
+                # a corpse (measured on-chip r4: 83 ms p50 queue-wait tax).
+                live = sum(
+                    1
+                    for r in inflight
+                    if any(
+                        not (j.cancelled or j.future.done()) for j in r.jobs
+                    )
+                )
+                rec = self._dispatch_next(live, len(inflight))
                 if rec is None:
                     break
                 inflight.append(rec)

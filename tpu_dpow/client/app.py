@@ -106,6 +106,17 @@ class DpowClient:
                 f"client/{self.config.payout_address}", qos=QOS_1
             )
         await self.work_handler.start()
+        # One startup line (reference client logs its connection status): a
+        # healthy worker is otherwise silent until the first stats snapshot,
+        # indistinguishable from one wedged in setup. Credentials stripped —
+        # the URI carries the broker password.
+        uri = self.config.server_uri.split("@")[-1]
+        logger.info(
+            "connected to %s; serving %s; %s backend ready",
+            uri,
+            ", ".join(f"work/{t}" for t in self.config.work_type.topics),
+            self.config.backend,
+        )
 
     async def _await_first_heartbeat(self) -> None:
         async for msg in self.transport.messages():
