@@ -81,10 +81,20 @@ while true; do
         echo "$(date -u +%FT%TZ) tunnel LIVE -> capturing (mark=$MARK steps=$STEPS)"
         # --skip_fresh resumes a capture a dead tunnel cut short: steps
         # already recorded rc==0 with this mark are kept, the rest re-run.
+        # SKIP_FRESH=0 forces every listed step to re-run even if fresh —
+        # for re-measuring steps whose numbers a mid-round code change
+        # invalidated, under the same mark the summarizer reads.
         # rc 3 = capture aborted because the tunnel died mid-run; keep
         # watching and resume on the next window. Any other rc: done.
+        skip_flag="--skip_fresh"
+        # Forced mode stays forced across rc-3 resumes on purpose: with
+        # skip, a resume would silently SKIP the steps not yet re-measured
+        # (their pre-change records are rc 0 under the same mark). Use
+        # SKIP_FRESH=0 only with a short step list, where re-running the
+        # already-landed steps next window costs minutes, not the capture.
+        [ "${SKIP_FRESH:-1}" = "0" ] && skip_flag=""
         python benchmarks/capture_evidence.py \
-            --steps "$STEPS" --mark "$MARK" --skip_fresh
+            --steps "$STEPS" --mark "$MARK" $skip_flag
         rc=$?
         if [ "$rc" -ne 3 ]; then
             echo "$(date -u +%FT%TZ) capture done (rc=$rc); timing a cold-process bench.py (compile-cache proof)"

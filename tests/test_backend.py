@@ -236,6 +236,28 @@ def test_mesh_backend_concurrent_and_cancel():
     asyncio.run(run())
 
 
+def test_mesh_devices_one_builds_real_gang():
+    """mesh_devices=1 must run the ACTUAL shard_map gang on a one-device
+    mesh — the engine-level A/B that prices the gang machinery against the
+    plain path on real hardware. A `> 1` guard used to silently downgrade
+    it to the plain path, so the r4 latency_mesh1 capture measured
+    plain-vs-plain session drift and called it the gang tax."""
+
+    async def run():
+        b = make_backend(mesh_devices=1)
+        assert b.mesh is not None
+        assert b.chunk == b.chunk_per_shard  # one shard, ungrown window
+        await b.setup()
+        h = random_hash()
+        work = await b.generate(WorkRequest(h, EASY))
+        nc.validate_work(h, work, EASY)
+        await b.close()
+        # Default stays the plain path: an unganged engine has no mesh.
+        assert make_backend().mesh is None
+
+    asyncio.run(run())
+
+
 def test_mesh_backend_rejects_oversubscription():
     import jax
 
@@ -845,23 +867,28 @@ def test_mixed_load_rung_fairness_under_flood():
         hard_n = sum(1 for s in window if s > 1)
         easy_n = sum(1 for s in window if s == 1)
         # A few full-width stragglers tolerated: a 16 can slip in while
-        # every flooder is momentarily between requests (hard rung truly
-        # alone on a drained pipe — more likely under CI/host contention).
-        # The regression signal is gross: pre-cap, ~half the window was 16s.
-        assert sum(1 for s in window if s == 16) <= 4, window
+        # every flooder is momentarily between requests — the hard rung is
+        # then truly alone (no alive easy job), which by design gets full
+        # width; the corpse-aware width policy widened that moment from
+        # "drained pipe" to "only dead launches in the pipe", so gaps are
+        # a bit likelier under CI/host contention. The regression signal
+        # is gross: pre-cap, ~half the window was 16s.
+        assert sum(1 for s in window if s == 16) <= 6, window
         # Round-robin over two live rungs → each gets ~half the launches;
-        # a third is the regression bound (serving one rung only would put
-        # the other at 0).
-        assert hard_n >= len(window) // 3, window
-        assert easy_n >= len(window) // 3, window
+        # a quarter is the regression bound (serving one rung only would
+        # put the other at 0; flooder gaps under host load eat a few).
+        assert hard_n >= len(window) // 4, window
+        assert easy_n >= len(window) // 4, window
         # And no rung monopolizes: no long consecutive same-rung streaks
-        # while both are pending (host-contention jitter gets one of slack).
+        # while both are pending (host-contention jitter gets one of slack,
+        # and a flood-gap full-width launch can extend a hard streak by
+        # one).
         run_len, worst, prev = 0, 0, None
         for s in window:
             run_len = run_len + 1 if s == prev else 1
             worst = max(worst, run_len)
             prev = s
-        assert worst <= 4, window
+        assert worst <= 5, window
 
     asyncio.run(run())
 

@@ -135,7 +135,7 @@ class _Launch:
 class JaxWorkBackend(WorkBackend):
     """Batched chunked nonce search on this host's jax.local_devices().
 
-    ``mesh_devices`` > 1 gangs that many devices onto every hash through the
+    ``mesh_devices`` >= 1 gangs that many devices onto every hash through the
     (batch, nonce) mesh of parallel/mesh_search.py — the flagship latency
     configuration: the <50 ms p50 target at difficulty fffffff800000000
     needs all 8 chips of a v5e-8 on one request (SURVEY.md §7 hard part #3).
@@ -155,7 +155,7 @@ class JaxWorkBackend(WorkBackend):
         max_batch: int = 16,
         interpret: bool = False,
         device: Optional[jax.Device] = None,
-        mesh_devices: int = 1,  # >1: gang this many devices per hash
+        mesh_devices: int = 0,  # >=1: gang this many devices per hash
         run_steps: Optional[int] = None,  # cap on windows per device launch
         warm_shapes: Optional[bool] = None,  # background-compile launch shapes
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
@@ -163,7 +163,15 @@ class JaxWorkBackend(WorkBackend):
         step_ladder: str = "x4",  # run-length quantization: 'x4' | 'x2'
         shared_steps_cap: Optional[int] = None,  # windows/launch under contention
     ):
-        if mesh_devices > 1:
+        if mesh_devices >= 1:
+            # 0 (default) = plain single-device dispatch. >= 1 builds the
+            # shard_map gang — INCLUDING 1: a one-device mesh runs the
+            # exact gang code with zero ICI traffic, the A/B configuration
+            # that prices the gang machinery on real hardware (r4 first
+            # measured it via benchmarks/gang_ab.py at raw-launch level:
+            # -1.0 ms, i.e. free; mesh_devices=1 prices it engine-level).
+            # An earlier `> 1` guard silently downgraded that A/B to the
+            # plain path, so its bench measured plain-vs-plain drift.
             # local_devices: under a jax.distributed multi-host slice the
             # per-worker gang must only claim this host's chips (ICI
             # domain); cross-host scale is the broker swarm's job, or an

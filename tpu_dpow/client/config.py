@@ -21,7 +21,7 @@ class ClientConfig:
     startup_heartbeat_wait: float = 2.0  # refuse to start without a live server
     reconnect_delay: float = 20.0
     max_batch: int = 16
-    mesh_devices: int = 1  # >1: gang N local chips per hash (backend=jax)
+    mesh_devices: int = 0  # >=1: gang N local chips per hash; 0 = plain (backend=jax)
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
     pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
     step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
@@ -64,8 +64,9 @@ def parse_args(argv=None) -> ClientConfig:
                    help="external work server (backend=subprocess)")
     p.add_argument("--max_batch", type=int, default=c.max_batch)
     p.add_argument("--mesh_devices", type=int, default=c.mesh_devices,
-                   help="gang N local devices onto every hash (backend=jax; "
-                   "the multi-chip latency mode)")
+                   help="gang N local devices onto every hash; 0 = plain "
+                   "single-device path (backend=jax; the multi-chip "
+                   "latency mode)")
     p.add_argument("--run_steps", type=int, default=c.run_steps,
                    help="max windows per device launch (backend=jax; 0 = "
                    "auto: device-resident runs on TPU, single windows "

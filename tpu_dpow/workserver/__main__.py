@@ -22,8 +22,9 @@ async def amain(argv=None) -> None:
     p.add_argument("--backend", default="jax", choices=["jax", "native"])
     p.add_argument("--threads", type=int, default=None,
                    help="native backend thread count")
-    p.add_argument("--mesh_devices", type=int, default=1,
-                   help="gang N local devices per hash (backend=jax)")
+    p.add_argument("--mesh_devices", type=int, default=0,
+                   help="gang N local devices per hash; 0 = plain "
+                   "single-device path (backend=jax)")
     p.add_argument("--compilation_cache", default="",
                    help="persistent XLA compilation cache dir ('' = off)")
     p.add_argument("--verbose", action="store_true")
@@ -48,7 +49,7 @@ async def amain(argv=None) -> None:
     # elsewhere is 'http://[::1]:7076'); getaddrinfo wants them bare.
     host = host.strip("[]")
     kwargs = {"threads": ns.threads} if ns.backend == "native" and ns.threads else {}
-    if ns.backend == "jax" and ns.mesh_devices > 1:
+    if ns.backend == "jax" and ns.mesh_devices > 0:
         kwargs["mesh_devices"] = ns.mesh_devices
     server = WorkServer(
         get_backend(ns.backend, **kwargs), host or "127.0.0.1", int(port_str)
