@@ -1058,6 +1058,63 @@ def test_fresh_head_full_width_behind_dead_launches():
     asyncio.run(asyncio.wait_for(run(), 30))
 
 
+def test_fresh_demand_dispatches_while_head_launch_in_flight():
+    """A fresh request arriving while the oldest launch's readback is on
+    the wire must be dispatched into a free pipeline slot immediately —
+    the engine loop's await is wakeup-interruptible. Before this, the loop
+    sat blocked in await and the fresh head launch started only after the
+    full wire round trip (the second half of the r4 83 ms queue-wait tax)."""
+    import threading
+
+    async def run():
+        # pipeline=3 with one EASY job fills exactly two slots (head +
+        # one speculative re-scan; the floor stops a third — pinned by
+        # test_pipeline_idle_speculation_kept_for_lone_job), leaving one
+        # slot free while the head is in flight.
+        b = make_backend(pipeline=3)
+        await b.setup()
+        lock = threading.Lock()
+        gates = [threading.Event() for _ in range(8)]
+        launches = []
+        real_launch = b._launch
+
+        def gated(params, steps):
+            with lock:
+                gate = gates[len(launches)]
+                launches.append(steps)
+            if not gate.wait(timeout=10):
+                raise TimeoutError("per-launch gate never released in 10s")
+            return real_launch(params, steps)
+
+        b._launch = gated
+        try:
+            r1 = WorkRequest(random_hash(), EASY)
+            t1 = asyncio.ensure_future(b.generate(r1))
+            while len(launches) < 2:
+                await asyncio.sleep(0.01)
+            await asyncio.sleep(0.05)
+            assert len(launches) == 2, launches  # speculation floor held
+            r2 = WorkRequest(random_hash(), EASY)
+            t2 = asyncio.ensure_future(b.generate(r2))
+            # The head launch is still gated; the new job's launch must
+            # appear anyway.
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while len(launches) < 3:
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "fresh job not dispatched while head launch in flight",
+                    launches,
+                )
+                await asyncio.sleep(0.01)
+        finally:
+            for g in gates:
+                g.set()
+        for r, w in zip((r1, r2), await asyncio.gather(t1, t2)):
+            nc.validate_work(r.block_hash, w, EASY)
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
 def test_timeline_records_launch_stages_and_solves():
     """record_timeline must stamp every launch's stage boundaries (the
     overhead decomposition in benchmarks/overhead.py reads them) and one
