@@ -74,6 +74,31 @@ def test_pow_work_value_scalar_golden():
         assert got == ref_work_value(nonce, block_hash)
 
 
+def test_compress_h0_matches_full_compress_and_hashlib():
+    """The final-round-pruned single-word compression (the TPU kernel's
+    hot path) must stay bit-exact with both the full compress and hashlib.
+    Runs EAGERLY on numpy via the u64 host path — the unrolled graph is
+    too slow to XLA-compile on CPU, which otherwise leaves the kernel's
+    exact compression untested off-TPU."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        block_hash = rng.bytes(32)
+        nonce = int(rng.integers(0, 1 << 64, dtype=np.uint64))
+        msg = blake2b.hash_to_message_words(block_hash)
+        zero = (np.uint32(0), np.uint32(0))
+        m = [split64(nonce)] + [
+            (msg[2 * i], msg[2 * i + 1]) for i in range(4)
+        ] + [zero] * 11
+        h = [u64.from_int(blake2b.H0_POW)] + [
+            u64.from_int(blake2b.IV[i]) for i in range(1, 8)
+        ]
+        lo, hi = blake2b.compress_h0(h, m, blake2b.POW_MESSAGE_LEN)
+        got = (int(hi) << 32) | int(lo)
+        full = blake2b.compress(h, m, blake2b.POW_MESSAGE_LEN, final=True)[0]
+        assert got == (int(full[1]) << 32) | int(full[0])
+        assert got == ref_work_value(nonce, block_hash)
+
+
 def test_pow_work_value_batched_jit_golden():
     rng = np.random.default_rng(3)
     block_hash = rng.bytes(32)

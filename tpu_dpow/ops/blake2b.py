@@ -78,16 +78,46 @@ def _is_const_zero(w: U64) -> bool:
     )
 
 
+def _g_prefix(
+    v: List[U64], a: int, b: int, c: int, d: int, x: U64, y: U64, stop: str
+) -> None:
+    """G computed only through the named output, written back in place.
+
+    ``stop``: ``"full"`` is the complete G; ``"a2"`` stops after the second
+    v[a] update (the caller needs only the final v[a]); ``"c2"`` stops after
+    the second v[c] update (needs v[a] and v[c], not the final v[b]). The
+    skipped slots keep their freshest computed prefix value — callers must
+    only read slots the chosen stop actually finalizes (compress_h0's final
+    round is the only prefix user, and it reads nothing it skips).
+    """
+    va = u64.add(v[a], v[b]) if _is_const_zero(x) else u64.add3(v[a], v[b], x)
+    vd = u64.rotr(u64.xor(v[d], va), 32)
+    vc = u64.add(v[c], vd)
+    vb = u64.rotr(u64.xor(v[b], vc), 24)
+    va = u64.add(va, vb) if _is_const_zero(y) else u64.add3(va, vb, y)
+    if stop != "a2":
+        vd = u64.rotr(u64.xor(vd, va), 16)
+        vc = u64.add(vc, vd)
+        if stop != "c2":
+            vb = u64.rotr(u64.xor(vb, vc), 63)
+    v[a], v[b], v[c], v[d] = va, vb, vc, vd
+
+
 def _g(v: List[U64], a: int, b: int, c: int, d: int, x: U64, y: U64) -> None:
     """Blake2b G mixing function on the working vector, in place."""
-    v[a] = u64.add(v[a], v[b]) if _is_const_zero(x) else u64.add3(v[a], v[b], x)
-    v[d] = u64.rotr(u64.xor(v[d], v[a]), 32)
-    v[c] = u64.add(v[c], v[d])
-    v[b] = u64.rotr(u64.xor(v[b], v[c]), 24)
-    v[a] = u64.add(v[a], v[b]) if _is_const_zero(y) else u64.add3(v[a], v[b], y)
-    v[d] = u64.rotr(u64.xor(v[d], v[a]), 16)
-    v[c] = u64.add(v[c], v[d])
-    v[b] = u64.rotr(u64.xor(v[b], v[c]), 63)
+    _g_prefix(v, a, b, c, d, x, y, "full")
+
+
+def _round(v: List[U64], s: Sequence[int], m: Sequence[U64]) -> None:
+    """One full Blake2b round: 4 column G's then 4 diagonal G's."""
+    _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+    _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+    _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+    _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+    _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+    _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+    _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+    _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
 
 
 def compress(
@@ -108,16 +138,44 @@ def compress(
     if final:
         v[14] = u64.xor(v[14], u64.from_int(0xFFFFFFFFFFFFFFFF))
     for r in range(12):
-        s = SIGMA[r]
-        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
-        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
-        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
-        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
-        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
-        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
-        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
-        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+        _round(v, SIGMA[r], m)
     return [u64.xor(u64.xor(h[i], v[i]), v[i + 8]) for i in range(8)]
+
+
+def compress_h0(
+    h: Sequence[U64],
+    m: Sequence[U64],
+    t0: int,
+) -> U64:
+    """compress() specialized to the ONE output word the PoW rule reads.
+
+    The work value is ``h[0] ^ v[0] ^ v[8]``, so the final round only needs
+    the value flow into v[0] (diagonal G(0,5,10,15)'s second a-update) and
+    v[8] (diagonal G(2,7,8,13)'s second c-update). Pruning the rest at
+    trace time — two of the four diagonal G's entirely, plus the unused
+    tails of the other G's — removes ~3% of the compression's vector ops
+    *by construction*, instead of relying on the kernel compiler's dead-code
+    elimination to chase the dataflow through 12 rounds. Bit-exact with
+    ``compress(...)[0]`` (pinned in tests/test_blake2b.py); final-block
+    flag always set (the PoW message is single-block by definition).
+    """
+    v: List[U64] = list(h) + [u64.from_int(IV[i]) for i in range(8)]
+    v[12] = u64.xor(v[12], u64.from_int(t0))
+    v[14] = u64.xor(v[14], u64.from_int(0xFFFFFFFFFFFFFFFF))
+    for r in range(11):
+        _round(v, SIGMA[r], m)
+    s = SIGMA[11]
+    # Columns: G0 feeds v[0] (a2) and v[8] (c2) — skip its final b.
+    # G1/G3 run full (the diagonals below read their b2 AND d2 outputs);
+    # G2 feeds v[2] (a2) and v[10] (c2) — skip its final b. Diagonals
+    # G(1,6,11,12) and G(3,4,9,14) write nothing h[0] reads: dropped.
+    _g_prefix(v, 0, 4, 8, 12, m[s[0]], m[s[1]], "c2")
+    _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+    _g_prefix(v, 2, 6, 10, 14, m[s[4]], m[s[5]], "c2")
+    _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+    _g_prefix(v, 0, 5, 10, 15, m[s[8]], m[s[9]], "a2")
+    _g_prefix(v, 2, 7, 8, 13, m[s[12]], m[s[13]], "c2")
+    return u64.xor(u64.xor(h[0], v[0]), v[8])
 
 
 def compress_rolled(
@@ -234,8 +292,10 @@ def pow_work_value(
     m.extend([zero] * 11)
 
     h: List[U64] = [u64.from_int(H0_POW)] + [u64.from_int(IV[i]) for i in range(1, 8)]
-    fn = compress if unroll else compress_rolled
-    return fn(h, m, POW_MESSAGE_LEN, final=True)[0]
+    if unroll:
+        # Kernel path: the final-round-pruned single-word compression.
+        return compress_h0(h, m, POW_MESSAGE_LEN)
+    return compress_rolled(h, m, POW_MESSAGE_LEN, final=True)[0]
 
 
 def pow_meets_difficulty(
