@@ -19,6 +19,7 @@ import argparse
 import asyncio
 import json
 import time
+from collections import Counter
 
 import numpy as np
 
@@ -50,6 +51,13 @@ async def run(
         # A/B in benchmarks/gang_ab.py.
         kwargs["mesh_devices"] = mesh_devices
     backend = get_backend(backend_name, **kwargs)
+    if hasattr(backend, "record_timeline"):
+        # Solve records carry the number of APPLIED launches the solve
+        # consumed — the launches-per-solve histogram below verifies the
+        # one-round-trip design (p50 at a rung's native difficulty solves
+        # on readback #1) and explains the p95 tail (each extra applied
+        # launch is a wire round trip on a remote chip).
+        backend.record_timeline = True
     await backend.setup()
     # Steady-state measurement: round 3's first capture timed solves while
     # the launch-shape warmup was still compiling, so most ran at steps=1
@@ -58,12 +66,29 @@ async def run(
     await _bootstrap.wait_for_warmup(backend)
     warm_wait_s = round(time.perf_counter() - t_warm, 1)
     times = []
+    launch_counts: Counter = Counter()
+
+    def drain_solves():
+        # Consume solve records each request: the shared timeline deque is
+        # bounded (maxlen 1024), so reading it only at the end would
+        # silently evict early solves on large --n or high multipliers.
+        tl = getattr(backend, "timeline", None)
+        if tl is None:
+            return
+        launch_counts.update(
+            t["launches"] for kind, t in tl if kind == "solve" and "launches" in t
+        )
+        tl.clear()
+
+    drain_solves()
+    launch_counts.clear()  # warmup/self-test records are not measurements
     for _ in range(n):
         h = RNG.bytes(32).hex().upper()
         t0 = time.perf_counter()
         work = await backend.generate(WorkRequest(h, difficulty))
         times.append(time.perf_counter() - t0)
         nc.validate_work(h, work, difficulty)
+        drain_solves()
     await backend.close()
     ms = np.asarray(sorted(times)) * 1e3
     print(
@@ -79,6 +104,7 @@ async def run(
                 "p95_ms": round(float(np.percentile(ms, 95)), 2),
                 "mean_ms": round(float(ms.mean()), 2),
                 "warm_wait_s": warm_wait_s,
+                "launches_per_solve": dict(sorted(launch_counts.items())),
             }
         )
     )

@@ -1141,6 +1141,11 @@ def test_timeline_records_launch_stages_and_solves():
             assert t["batch"] >= 1 and t["steps"] >= 1 and t["inflight"] >= 0
         for s in solves:
             assert 0 <= s["queue_wait"] <= s["total"]
+            # Every solve consumed at least one applied launch; the count
+            # feeds latency.py's launches-per-solve histogram. Counted at
+            # apply, so an in-flight speculative successor cannot inflate
+            # it past the solving readback's position.
+            assert s["launches"] >= 1
 
         b2 = make_backend()
         await b2.setup()

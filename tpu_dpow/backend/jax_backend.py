@@ -100,6 +100,13 @@ class _Job:
     # Timeline stamps (record_timeline only): submission and first dispatch.
     t_submit: float = 0.0
     t_first_dispatch: float = 0.0
+    # Launches including this job whose results have been APPLIED — the
+    # solving launch's position in the job's readback sequence. Counted at
+    # apply (not dispatch) so an in-flight speculative successor does not
+    # inflate it: the solve record reports the number of wire round trips
+    # the solve actually consumed (the one-round-trip design ⇒ p50 of 1 at
+    # a rung's native difficulty).
+    applied_launches: int = 0
 
     def set_base(self, base: int) -> None:
         self.base = base & _MASK64
@@ -822,6 +829,7 @@ class JaxWorkBackend(WorkBackend):
             # This launch is no longer in flight: undo its coverage factor
             # (clamped — repeated multiply/divide may drift past 1.0).
             job.inflight_miss = min(1.0, job.inflight_miss / f)
+            job.applied_launches += 1
         for job, launched, base, lo, hi in zip(
             rec.jobs, rec.launched_difficulty, rec.bases,
             lo_arr[: len(rec.jobs)], hi_arr[: len(rec.jobs)],
@@ -848,6 +856,7 @@ class JaxWorkBackend(WorkBackend):
                         {
                             "queue_wait": job.t_first_dispatch - job.t_submit,
                             "total": now - job.t_submit,
+                            "launches": job.applied_launches,
                         },
                     ))
             elif value >= launched:
