@@ -104,6 +104,7 @@ async def start_full_stack(debug: bool = False):
     return SimpleNamespace(
         runner=runner, store=store, server=server, client=client,
         backend=backend, on_tpu=on_tpu, ports=runner.ports,
+        base_difficulty=config.base_difficulty,
     )
 
 

@@ -49,15 +49,24 @@ async def run(n: int, concurrency: int) -> None:
             else:
                 errors[0] += 1
 
+    hashes0 = getattr(stack.backend, "total_hashes", 0)
+    solves0 = getattr(stack.backend, "total_solutions", 0)
     t0 = time.perf_counter()
     async with aiohttp.ClientSession() as session:
         await asyncio.gather(*(one(session) for _ in range(n)))
     wall = time.perf_counter() - t0
+    # Device-efficiency accounting (the e2e twin of batch.py's overscan
+    # signal): hashes the device actually ground per request served, vs the
+    # 1/p expectation. Sampled before teardown — close() would drop the
+    # engine's in-flight residue on the floor either way.
+    device_hashes = getattr(stack.backend, "total_hashes", 0) - hashes0
+    device_solves = getattr(stack.backend, "total_solutions", 0) - solves0
 
     await stack.client.close()
     await stack.runner.stop()
 
     ms = np.asarray(sorted(times)) * 1e3
+    p_solve = (2**64 - stack.base_difficulty) / 2**64
     print(
         json.dumps(
             {
@@ -71,6 +80,13 @@ async def run(n: int, concurrency: int) -> None:
                 "req_per_sec": round(len(times) / wall, 2),
                 "p50_ms": round(float(np.percentile(ms, 50)), 1) if len(times) else None,
                 "p95_ms": round(float(np.percentile(ms, 95)), 1) if len(times) else None,
+                "device_hashes": int(device_hashes),
+                "device_solves": int(device_solves),
+                "hashes_per_ok_vs_bound": (
+                    round(device_hashes * p_solve / len(times), 3)
+                    if len(times)
+                    else None
+                ),
             }
         )
     )
