@@ -124,3 +124,20 @@ async def wait_for_warmup(backend, timeout: float = 600.0) -> None:
         await asyncio.wait_for(asyncio.shield(warm_task), timeout=timeout)
     except asyncio.TimeoutError:
         print(f"# warmup still incomplete after {timeout:.0f}s; measuring anyway")
+
+
+def drain_solves(backend, counter) -> None:
+    """Fold the timeline's solve records into ``counter`` and clear it.
+
+    Benchmarks reporting launches-per-solve histograms call this after each
+    measured request: the engine's timeline deque is bounded (maxlen 1024),
+    so reading it only at the end silently evicts early solves on large
+    runs. No-op for backends without a timeline (native).
+    """
+    tl = getattr(backend, "timeline", None)
+    if tl is None:
+        return
+    counter.update(
+        t["launches"] for kind, t in tl if kind == "solve" and "launches" in t
+    )
+    tl.clear()

@@ -46,16 +46,28 @@ async def run(n: int, settle: float) -> None:
     if platform != "tpu":
         easy = min(easy, 0xFFF0000000000000)  # keep CPU runs sane
     backend = get_backend("jax")
+    # Solve records carry applied-launch counts: the post-cancel probe's
+    # histogram shows whether it solved on its first readback (the corpse-
+    # aware full-width head) or chained extra wire round trips behind the
+    # cancelled job's dying launches.
+    backend.record_timeline = True
     await backend.setup()
     await _bootstrap.wait_for_warmup(backend)
 
+    from collections import Counter
+
     solo, post_cancel = [], []
+    solo_launches: Counter = Counter()
+    probe_launches: Counter = Counter()
+
+    backend.timeline.clear()  # warmup/self-test records are not measurements
     for _ in range(n):
         # Solo baseline: easy request on an idle engine.
         h = RNG.bytes(32).hex().upper()
         t0 = time.perf_counter()
         await backend.generate(WorkRequest(h, easy))
         solo.append(time.perf_counter() - t0)
+        _bootstrap.drain_solves(backend, solo_launches)
 
         # Drain trial: hard job fills the pipeline, then cancel + fresh easy.
         hard = RNG.bytes(32).hex().upper()
@@ -72,6 +84,7 @@ async def run(n: int, settle: float) -> None:
             await t_hard
         except WorkCancelled:
             pass
+        _bootstrap.drain_solves(backend, probe_launches)
 
     await backend.close()
     solo_ms = np.asarray(sorted(solo)) * 1e3
@@ -90,6 +103,8 @@ async def run(n: int, settle: float) -> None:
                 ),
                 "bound_windows": backend.run_steps
                 + (backend.pipeline - 1) * backend.shared_steps_cap,
+                "solo_launches_per_solve": dict(sorted(solo_launches.items())),
+                "probe_launches_per_solve": dict(sorted(probe_launches.items())),
                 "geometry": {
                     "run_steps": backend.run_steps,
                     "pipeline": backend.pipeline,

@@ -67,28 +67,15 @@ async def run(
     warm_wait_s = round(time.perf_counter() - t_warm, 1)
     times = []
     launch_counts: Counter = Counter()
-
-    def drain_solves():
-        # Consume solve records each request: the shared timeline deque is
-        # bounded (maxlen 1024), so reading it only at the end would
-        # silently evict early solves on large --n or high multipliers.
-        tl = getattr(backend, "timeline", None)
-        if tl is None:
-            return
-        launch_counts.update(
-            t["launches"] for kind, t in tl if kind == "solve" and "launches" in t
-        )
-        tl.clear()
-
-    drain_solves()
-    launch_counts.clear()  # warmup/self-test records are not measurements
+    scratch: Counter = Counter()
+    _bootstrap.drain_solves(backend, scratch)  # discard warmup/self-test
     for _ in range(n):
         h = RNG.bytes(32).hex().upper()
         t0 = time.perf_counter()
         work = await backend.generate(WorkRequest(h, difficulty))
         times.append(time.perf_counter() - t0)
         nc.validate_work(h, work, difficulty)
-        drain_solves()
+        _bootstrap.drain_solves(backend, launch_counts)
     await backend.close()
     ms = np.asarray(sorted(times)) * 1e3
     print(
