@@ -8,11 +8,14 @@ on-chip capture turns into an actionable gap list in one command:
 
 Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
   headline   ≥ 1e9 H/s on platform tpu
-  flood      ≥ 14 req/s (≈75% of the r3-measured 18.6/s device ceiling)
+  flood      ≥ 14 req/s (≈75% of the r3-measured 18.6/s device ceiling);
+             when the record carries hashes_per_ok_vs_bound, also ≤ 1.2x
   batch      ≤ 1.2x the per-solve hash bound
   fairness   added_p50 ≥ 0 (a tax, not a credit)
   precache   hit p50 ≤ 25 ms with zero errors (cache hit, not device wait)
-  cancel     post-cancel added_p50 within the residue bound
+  cancel     post-cancel added_p50 within the residue bound; when the
+             record carries probe_launches_per_solve, a strict majority of
+             probes must solve on their first applied readback
   tests_tpu  rc 0
   gang_ab    machinery delta reported (informational)
 """
@@ -73,8 +76,14 @@ def main() -> int:
 
     r = res(step("flood"))
     if r:
-        row("flood", r.get("req_per_sec", 0) >= 14,
-            f"{r.get('req_per_sec')} req/s, p50 {r.get('p50_ms')} ms")
+        # The e2e overscan signal (same 1.2x criterion as the batch step)
+        # gates alongside throughput when the record carries it.
+        ratio = r.get("hashes_per_ok_vs_bound")
+        ok = r.get("req_per_sec", 0) >= 14 and (ratio is None or ratio <= 1.2)
+        detail = f"{r.get('req_per_sec')} req/s, p50 {r.get('p50_ms')} ms"
+        if ratio is not None:
+            detail += f", {ratio}x the 1/p bound"
+        row("flood", ok, detail)
     else:
         row("flood", None, "no fresh record")
 
@@ -112,8 +121,17 @@ def main() -> int:
             bound_ms = r.get("bound_windows", 20) * 3.7 + 2 * floor
         else:
             bound_ms = r.get("bound_windows", 20) * 3.7 * 2
-        row("cancel", r.get("added_p50_ms", 1e9) <= bound_ms,
-            f"added_p50 {r.get('added_p50_ms')} ms vs ~{bound_ms:.0f} ms bound")
+        ok = r.get("added_p50_ms", 1e9) <= bound_ms
+        detail = f"added_p50 {r.get('added_p50_ms')} ms vs ~{bound_ms:.0f} ms bound"
+        probe = r.get("probe_launches_per_solve")
+        if probe:
+            # A STRICT majority of post-cancel probes must solve on their
+            # first applied readback — the corpse-aware full-width head
+            # working (a 50/50 split is half the probes degraded: fail).
+            first = probe.get("1", probe.get(1, 0))
+            ok = ok and first * 2 > sum(probe.values())
+            detail += f", probe launches {probe}"
+        row("cancel", ok, detail)
     else:
         row("cancel", None, "no fresh record")
 

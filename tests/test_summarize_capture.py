@@ -84,6 +84,35 @@ def test_precache_gates_on_hit_latency_and_errors(tmp_path):
     assert rows["precache"][0] == "FAIL"
 
 
+def test_flood_gates_on_e2e_overscan_ratio_when_present(tmp_path):
+    rec = {"rc": 0, "result": {"req_per_sec": 18.8, "p50_ms": 940.0,
+                               "hashes_per_ok_vs_bound": 1.04}}
+    _, rows = summarize(tmp_path, {"flood": rec})
+    assert rows["flood"][0] == "PASS" and "1.04x" in rows["flood"][1]
+    rec["result"]["hashes_per_ok_vs_bound"] = 1.8  # r3's overscan regime
+    _, rows = summarize(tmp_path, {"flood": rec})
+    assert rows["flood"][0] == "FAIL"
+    del rec["result"]["hashes_per_ok_vs_bound"]  # old record: rate only
+    _, rows = summarize(tmp_path, {"flood": rec})
+    assert rows["flood"][0] == "PASS"
+
+
+def test_cancel_gates_on_probe_first_readback_majority(tmp_path):
+    rec = {"rc": 0, "result": {
+        "added_p50_ms": 100.0, "bound_windows": 20,
+        "probe_launches_per_solve": {"1": 8, "2": 2}}}
+    _, rows = summarize(tmp_path, {"cancel": rec})
+    assert rows["cancel"][0] == "PASS"
+    # Probes mostly chaining extra readbacks = the corpse demotion is back.
+    rec["result"]["probe_launches_per_solve"] = {"1": 2, "3": 8}
+    _, rows = summarize(tmp_path, {"cancel": rec})
+    assert rows["cancel"][0] == "FAIL"
+    # Exactly half degraded is not a majority solving on readback #1.
+    rec["result"]["probe_launches_per_solve"] = {"1": 5, "2": 5}
+    _, rows = summarize(tmp_path, {"cancel": rec})
+    assert rows["cancel"][0] == "FAIL"
+
+
 def test_cancel_bound_prices_launch_floor_from_overhead_record(tmp_path):
     # The drain serializes ~2 launch round trips, so the bound must widen
     # with the SAME capture's measured padded-launch floor: 20*3.7 + 2*66
