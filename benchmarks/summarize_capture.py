@@ -17,7 +17,16 @@ Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
              record carries probe_launches_per_solve, a strict majority of
              probes must solve on their first applied readback
   tests_tpu  rc 0
+  soak       zero errors and zero leaked jobs
   gang_ab    machinery delta reported (informational)
+
+Invalidated records (VERDICT r4 item 4): a capture record the docs have
+disavowed (e.g. r4's latency_mesh1 183.6 ms, measured through a guard bug)
+must be UN-GRADABLE — never PASS — even though its rc is 0 and its mark
+matches. benchmarks/invalidated.json lists them declaratively; a matching
+record grades as `stale` with the reason printed. Matching is pinned to the
+step + mark + a result-field fingerprint, so a genuine re-capture under the
+same mark (different measured values) automatically supersedes the entry.
 """
 
 from __future__ import annotations
@@ -37,11 +46,69 @@ def res(record):
     return (record or {}).get("result") or {}
 
 
+def load_invalidations(path=None):
+    """Declarative list of disavowed records (benchmarks/invalidated.json).
+
+    Each entry: {"step": name, "mark": mark-or-null, "match": {result-field:
+    value, ...}, "reason": text}. A record is invalidated only when the step
+    name matches, the mark matches (a null mark matches any), and EVERY
+    match field equals the record's result value — the fingerprint is what
+    lets a re-capture under the same mark supersede the entry without
+    editing this file.
+    """
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "invalidated.json")
+        if not os.path.exists(path):
+            return []  # no disavowal list in this checkout: nothing to do
+    try:
+        with open(path) as f:
+            entries = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        # Fail LOUD, not open: a truncated/merge-conflicted list silently
+        # re-enables PASS for every disavowed record — the exact false
+        # evidence the list exists to block.
+        print(f"WARNING: invalidation list {path} unreadable ({e}); "
+              "NO records will be disavowed", flush=True)
+        return []
+    if not isinstance(entries, list):
+        print(f"WARNING: invalidation list {path} is not a JSON list; "
+              "ignoring it", flush=True)
+        return []
+    kept = []
+    for e in entries:
+        if not (isinstance(e, dict) and e.get("step") and e.get("match")):
+            # An entry without a result-field fingerprint can never match
+            # (and match-all semantics would break re-capture supersession):
+            # surface it instead of silently grading the record PASS.
+            print(f"WARNING: invalidation entry ignored (needs 'step' and a "
+                  f"non-empty 'match' fingerprint): {json.dumps(e)[:120]}",
+                  flush=True)
+            continue
+        kept.append(e)
+    return kept
+
+
+def invalidation_reason(name, rec, entries):
+    r = res(rec)
+    for e in entries:
+        if e.get("step") != name:
+            continue
+        if e.get("mark") is not None and rec.get("mark") != e.get("mark"):
+            continue
+        match = e.get("match") or {}
+        if match and all(r.get(k) == v for k, v in match.items()):
+            return e.get("reason", "invalidated (no reason recorded)")
+    return None
+
+
 def main() -> int:
     p = argparse.ArgumentParser("capture summary vs round criteria")
     p.add_argument("--mark", default=None,
                    help="only trust steps recorded with this mark")
     p.add_argument("--path", default=os.path.join(REPO, "BENCH_latency.json"))
+    p.add_argument("--invalidated", default=None,
+                   help="override the invalidation list path (tests)")
     args = p.parse_args()
     try:
         with open(args.path) as f:
@@ -50,17 +117,27 @@ def main() -> int:
         print(f"no capture to summarize: {e}")
         return 1
 
+    invalidations = load_invalidations(args.invalidated)
+    stale = {}  # step name -> invalidation reason (for the row printer)
+
     def step(name):
         rec = data.get(name)
         if not isinstance(rec, dict):
             return None
         if args.mark and rec.get("mark") != args.mark:
             return None  # stale: from a previous revision's capture
+        reason = invalidation_reason(name, rec, invalidations)
+        if reason is not None:
+            stale[name] = reason
+            return None  # disavowed: un-gradable, never PASS
         return rec
 
     rows = []
 
     def row(name, ok, detail):
+        if ok is None and name in stale:
+            rows.append((name, "stale", f"INVALIDATED: {stale[name]}"))
+            return
         rows.append((name, {True: "PASS", False: "FAIL", None: "absent"}[ok], detail))
 
     r = res(step("headline"))
@@ -146,9 +223,19 @@ def main() -> int:
     else:
         row("precache", None, "no fresh record")
 
+    r = res(step("soak"))
+    if r:
+        # soak.py self-gates (rc 1 on error/leak); mirror it so a soak that
+        # recorded a nonzero error or leaked job can never read as PASS.
+        row("soak", r.get("error", 1) == 0 and r.get("leaks", 1) == 0,
+            f"ops {r.get('ops')}, ok {r.get('ok')}, errors {r.get('error')}, "
+            f"leaks {r.get('leaks')}, {r.get('ok_per_sec')}/s")
+    else:
+        row("soak", None, "no fresh record")
+
     for informational in ("gang_ab", "latency_mesh1", "latency_base",
-                          "latency_base_x2ladder", "overhead", "chaos_crossproc",
-                          "throughput_sweep"):
+                          "latency_8x", "latency_base_x2ladder", "overhead",
+                          "chaos_crossproc", "throughput_sweep"):
         r = res(step(informational))
         if r:
             keep = {k: v for k, v in r.items()
