@@ -105,6 +105,10 @@ async def run(n: int, settle: float) -> None:
                 + (backend.pipeline - 1) * backend.shared_steps_cap,
                 "solo_launches_per_solve": dict(sorted(solo_launches.items())),
                 "probe_launches_per_solve": dict(sorted(probe_launches.items())),
+                # Measured with record_timeline on (per-launch stamps on the
+                # timed path; trace_cost.py prices it) — cross-capture
+                # comparisons should match regimes (ADVICE r4).
+                "timeline_instrumented": True,
                 "geometry": {
                     "run_steps": backend.run_steps,
                     "pipeline": backend.pipeline,

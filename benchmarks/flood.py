@@ -87,6 +87,17 @@ async def run(n: int, concurrency: int) -> None:
                     if len(times)
                     else None
                 ),
+                # Error-adjusted twin (the summarizer's gate prefers it):
+                # every request in this bench dispatches device work before
+                # it can fail (auth always passes, hashes are valid), so
+                # dividing by ALL requests measures device efficiency —
+                # per-ok alone inflates on a run with errors and would fail
+                # the 1.2x gate for request failures, not overscan.
+                "hashes_per_req_vs_bound": (
+                    round(device_hashes * p_solve / (len(times) + errors[0]), 3)
+                    if (len(times) + errors[0])
+                    else None
+                ),
             }
         )
     )

@@ -92,6 +92,13 @@ async def run(
                 "mean_ms": round(float(ms.mean()), 2),
                 "warm_wait_s": warm_wait_s,
                 "launches_per_solve": dict(sorted(launch_counts.items())),
+                # The measured path carries record_timeline (per-launch
+                # perf_counter stamps + deque appends) — a small systematic
+                # shift vs pre-r4 captures that ran without it; trace_cost.py
+                # prices the instrumentation. Recorded so cross-capture
+                # comparisons know which regime a number came from (ADVICE r4).
+                "timeline_instrumented": bool(
+                    getattr(backend, "record_timeline", False)),
             }
         )
     )

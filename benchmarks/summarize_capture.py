@@ -154,10 +154,19 @@ def main() -> int:
     r = res(step("flood"))
     if r:
         # The e2e overscan signal (same 1.2x criterion as the batch step)
-        # gates alongside throughput when the record carries it.
-        ratio = r.get("hashes_per_ok_vs_bound")
-        ok = r.get("req_per_sec", 0) >= 14 and (ratio is None or ratio <= 1.2)
-        detail = f"{r.get('req_per_sec')} req/s, p50 {r.get('p50_ms')} ms"
+        # gates alongside throughput when the record carries it. Errors gate
+        # FIRST: with errors > 0 neither ratio is trustworthy (per-ok
+        # inflates — device hashes spent on errored requests sit only in
+        # its numerator; per-req dilutes — an errored request that aborted
+        # cheaply is credited a full 1/p budget), and a flood run with
+        # failures is not a PASS anyway. With errors == 0 the two ratios
+        # are equal; prefer the error-adjusted one, falling back to per-ok
+        # for records predating it (ADVICE r4).
+        ratio = r.get("hashes_per_req_vs_bound", r.get("hashes_per_ok_vs_bound"))
+        ok = (r.get("req_per_sec", 0) >= 14 and r.get("errors", 0) == 0
+              and (ratio is None or ratio <= 1.2))
+        detail = (f"{r.get('req_per_sec')} req/s, p50 {r.get('p50_ms')} ms, "
+                  f"errors {r.get('errors', 0)}")
         if ratio is not None:
             detail += f", {ratio}x the 1/p bound"
         row("flood", ok, detail)

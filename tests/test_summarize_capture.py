@@ -17,6 +17,16 @@ SCRIPT = os.path.join(REPO, "benchmarks", "summarize_capture.py")
 def summarize(tmp_path, data, argv=()):
     path = tmp_path / "bench.json"
     path.write_text(json.dumps(data))
+    argv = list(argv)
+    if "--invalidated" not in argv:
+        # Hermetic by default: a future entry in the repo's live
+        # benchmarks/invalidated.json whose fingerprint happened to match a
+        # synthetic record here would silently flip unrelated assertions.
+        # Only test_repo_invalidation_list_covers_the_r4_mesh1_record reads
+        # the real file (directly, not via this helper).
+        empty = tmp_path / "_no_invalidations.json"
+        empty.write_text("[]")
+        argv += ["--invalidated", str(empty)]
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     proc = subprocess.run(
@@ -95,6 +105,27 @@ def test_flood_gates_on_e2e_overscan_ratio_when_present(tmp_path):
     del rec["result"]["hashes_per_ok_vs_bound"]  # old record: rate only
     _, rows = summarize(tmp_path, {"flood": rec})
     assert rows["flood"][0] == "PASS"
+
+
+def test_flood_gate_prefers_error_adjusted_ratio(tmp_path):
+    # With zero errors the two ratios are equal and the error-adjusted one
+    # wins when present; a nonzero error count fails outright — per-ok
+    # inflates and per-req dilutes (a cheaply-aborted errored request is
+    # credited a full 1/p budget), so neither ratio is gateable.
+    rec = {"rc": 0, "result": {"req_per_sec": 18.0, "p50_ms": 950.0,
+                               "errors": 0,
+                               "hashes_per_ok_vs_bound": 1.05,
+                               "hashes_per_req_vs_bound": 1.05}}
+    _, rows = summarize(tmp_path, {"flood": rec})
+    assert rows["flood"][0] == "PASS" and "1.05x" in rows["flood"][1]
+    rec["result"]["hashes_per_req_vs_bound"] = 1.4  # genuine overscan
+    _, rows = summarize(tmp_path, {"flood": rec})
+    assert rows["flood"][0] == "FAIL"
+    # Errors gate first: ratio dilution cannot mask overscan on a lossy run.
+    rec["result"].update(errors=50, hashes_per_req_vs_bound=1.0,
+                         hashes_per_ok_vs_bound=2.0)
+    _, rows = summarize(tmp_path, {"flood": rec})
+    assert rows["flood"][0] == "FAIL"
 
 
 def test_cancel_gates_on_probe_first_readback_majority(tmp_path):
