@@ -1,0 +1,38 @@
+"""Roofline accounting contract (docs/roofline.md).
+
+The MFU claim rests on the traced op count of the kernel hot-loop body; pin
+it so a regression that un-prunes the final round, re-emits the zero-word
+adds, or un-hoists the nonce-invariant dataflow shows up as a failed test
+instead of a silently wrong roofline.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import roofline  # noqa: E402
+
+
+def test_ops_per_hash_stays_pruned():
+    counts = roofline.count_ops_per_hash()
+    # Traced at 4,403 (jax 0.9 era); the band allows minor tracer drift but
+    # catches the two real regressions: losing the final-round pruning
+    # (+180) or the zero-message-word elision (+hundreds).
+    assert 4200 <= counts["ops_per_hash"] <= 4500, counts
+    # The carry casts exist and are a minority of ops.
+    casts = counts["ops_per_hash"] - counts["ops_per_hash_ex_casts"]
+    assert 0 < casts < 0.15 * counts["ops_per_hash"], counts
+    # Nonce-invariant work must stay scalar-shaped (hoistable); if these
+    # ops start carrying the tile shape the per-hash count silently bloats.
+    assert counts["hoisted_scalar_ops"] > 0, counts
+
+
+def test_ceiling_exceeds_north_star():
+    # The derived VPU ceiling must sit above the 1e9 H/s target — if the
+    # op count ever grows past that crossover, the target itself becomes
+    # unreachable and the roofline doc is stale.
+    counts = roofline.count_ops_per_hash()
+    ceiling = roofline.V5E_VPU_OPS_PER_SEC / counts["ops_per_hash"]
+    assert ceiling > 1e9, ceiling
