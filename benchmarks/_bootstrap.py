@@ -32,7 +32,7 @@ from tpu_dpow.utils import enable_default_compilation_cache  # noqa: E402
 enable_default_compilation_cache()
 
 
-async def start_full_stack(debug: bool = False):
+async def start_full_stack(debug: bool = False, backend_factory=None):
     """In-process full stack for the e2e benches (flood, precache).
 
     Broker + server + HTTP runner + one worker client on the jax backend,
@@ -43,6 +43,8 @@ async def start_full_stack(debug: bool = False):
 
     ``debug=True`` makes every confirmed block precache-eligible
     (server/app.py block_arrival_handler) without seeding frontiers first.
+    ``backend_factory`` (gang_e2e) overrides the worker backend while
+    keeping every other stack knob identical to the plain benches.
     """
     from types import SimpleNamespace
 
@@ -86,11 +88,12 @@ async def start_full_stack(debug: bool = False):
     )
     await store.sadd("services", "bench")
 
-    backend = (
-        JaxWorkBackend()
-        if on_tpu
-        else JaxWorkBackend(kernel="xla", sublanes=8, iters=8, max_batch=32)
-    )
+    if backend_factory is not None:
+        backend = backend_factory()
+    elif on_tpu:
+        backend = JaxWorkBackend()
+    else:
+        backend = JaxWorkBackend(kernel="xla", sublanes=8, iters=8, max_batch=32)
     client = DpowClient(
         ClientConfig(payout_address=nc.encode_account(bytes(range(32))),
                      startup_heartbeat_wait=3.0),

@@ -49,6 +49,13 @@ STEPS = [
     ("precache", [sys.executable, "benchmarks/precache.py", "--n", "30"], 600),
     ("cancel", [sys.executable, "benchmarks/cancel_latency.py", "--n", "10"], 600),
     ("gang_ab", [sys.executable, "benchmarks/gang_ab.py", "--reps", "20"], 600),
+    # Virtual-mesh step (never touches the TPU): graded e2e drive of the
+    # ganged engine at the flagship gang size. env(1) strips the axon
+    # plugin dir from PYTHONPATH — during an outage its sitecustomize
+    # blocks interpreter startup, which no in-script pinning can fix —
+    # and _bootstrap re-adds the repo root itself.
+    ("gang_e2e", ["env", "PYTHONPATH=", "JAX_PLATFORMS=cpu",
+                  sys.executable, "benchmarks/gang_e2e.py"], 900),
     ("latency_mesh1", [sys.executable, "benchmarks/latency.py", "--n", "15",
                        "--mesh_devices", "1"], 900),
     ("overhead", [sys.executable, "benchmarks/overhead.py"], 900),
@@ -66,6 +73,11 @@ STEPS = [
 
 
 AXON_SITE = "/root/.axon_site"
+# Steps that pin themselves to CPU and never touch the chip: a failure here
+# is a real failure, not tunnel weather — the dead-tunnel abort must not
+# swallow it (it skips the attempts increment, so a genuine regression
+# would re-run and re-abort every window, starving the steps below it).
+CPU_ONLY_STEPS = {"gang_e2e"}
 # A resumed capture re-runs a previously failed step at most this many times
 # before skipping past it (see the retry-cap comment in main()).
 MAX_STEP_ATTEMPTS = 2
@@ -385,7 +397,8 @@ def main() -> int:
             record["mark"] = args.mark
         failed = record["rc"] != 0
         yielded = record["rc"] == "yielded"
-        tunnel_died = (failed and not yielded and not args.no_dead_tunnel_abort
+        tunnel_died = (failed and not yielded and name not in CPU_ONLY_STEPS
+                       and not args.no_dead_tunnel_abort
                        and not tunnel_alive())
         if prior_marked:
             if tunnel_died or yielded:

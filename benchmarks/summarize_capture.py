@@ -18,6 +18,7 @@ Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
              probes must solve on their first applied readback
   tests_tpu  rc 0
   soak       zero errors and zero leaked jobs
+  gang_e2e   gang engaged, all requests validate, p50/machinery in-bounds
   gang_ab    machinery delta reported (informational)
 
 Invalidated records (VERDICT r4 item 4): a capture record the docs have
@@ -140,8 +141,29 @@ def main() -> int:
             return
         rows.append((name, {True: "PASS", False: "FAIL", None: "absent"}[ok], detail))
 
-    r = res(step("headline"))
-    if r:
+    def crit(name):
+        """(result, crash_detail) for a graded step.
+
+        A record under the current mark whose rc is neither 0 nor "yielded"
+        is a CRASH: the step died before printing its result JSON (a
+        regression that raises instead of degrading). It must grade FAIL,
+        not absent — "absent" doesn't count toward the exit code, so a hard
+        break would read as missing evidence and summarize clean. "yielded"
+        (killed to hand the chip to a driver bench) stays absent: not the
+        code's failure.
+        """
+        rec = step(name)
+        if rec is None:
+            return {}, None
+        if rec.get("rc", 0) not in (0, "yielded"):
+            tail = (rec.get("stderr_tail") or rec.get("tail") or [""])[-1]
+            return {}, f"step failed rc={rec.get('rc')} {tail}".strip()
+        return res(rec), None
+
+    r, crash = crit("headline")
+    if crash:
+        row("headline", False, crash)
+    elif r:
         row("headline", r.get("platform") == "tpu" and r.get("value", 0) >= 1e9,
             f"{r.get('value', 0)/1e9:.3f} GH/s on {r.get('platform')}")
     else:
@@ -151,8 +173,10 @@ def main() -> int:
     row("tests_tpu", (r or {}).get("rc") == 0 if r else None,
         f"rc={(r or {}).get('rc')}" if r else "no fresh record")
 
-    r = res(step("flood"))
-    if r:
+    r, crash = crit("flood")
+    if crash:
+        row("flood", False, crash)
+    elif r:
         # The e2e overscan signal (same 1.2x criterion as the batch step)
         # gates alongside throughput when the record carries it. Errors gate
         # FIRST: with errors > 0 neither ratio is trustworthy (per-ok
@@ -173,8 +197,10 @@ def main() -> int:
     else:
         row("flood", None, "no fresh record")
 
-    r = res(step("batch"))
-    if r and r.get("device_hashes") and r.get("batch") and r.get("difficulty"):
+    r, crash = crit("batch")
+    if crash:
+        row("batch", False, crash)
+    elif r and r.get("device_hashes") and r.get("batch") and r.get("difficulty"):
         # ratio of scanned hashes to the 1/p expectation per solve
         p_solve = (2**64 - int(r["difficulty"], 16)) / 2**64
         bound = r["batch"] / p_solve
@@ -185,16 +211,20 @@ def main() -> int:
     else:
         row("batch", None, "no fresh record")
 
-    r = res(step("fairness"))
-    if r:
+    r, crash = crit("fairness")
+    if crash:
+        row("fairness", False, crash)
+    elif r:
         row("fairness", r.get("added_p50_ms", -1) >= 0,
             f"added_p50 {r.get('added_p50_ms')} ms (solo {r.get('solo_p50_ms')}, "
             f"mixed {r.get('mixed_p50_ms')})")
     else:
         row("fairness", None, "no fresh record")
 
-    r = res(step("cancel"))
-    if r:
+    r, crash = crit("cancel")
+    if crash:
+        row("cancel", False, crash)
+    elif r:
         # Residue bound in ms: bound_windows of scan at flagship throughput
         # (~3.7 ms/window) plus the launch round trips the drain inherently
         # serializes — the run loop awaits the corpse launch's readback, and
@@ -221,8 +251,10 @@ def main() -> int:
     else:
         row("cancel", None, "no fresh record")
 
-    r = res(step("precache"))
-    if r:
+    r, crash = crit("precache")
+    if crash:
+        row("precache", False, crash)
+    elif r:
         # The hit path does zero device work; r2 measured p50 1.8 ms. Allow
         # generous headroom — anything near one HTTP round trip passes, a
         # hit that waits on the device (~100+ ms through the tunnel) fails.
@@ -232,8 +264,36 @@ def main() -> int:
     else:
         row("precache", None, "no fresh record")
 
-    r = res(step("soak"))
-    if r:
+    r, crash = crit("gang_e2e")
+    if crash:
+        row("gang_e2e", False, crash)
+    elif r:
+        # Full-stack drive of the ganged engine on the virtual 8-mesh: the
+        # gang must actually engage, every request (sequential + burst, both
+        # modes) must validate, and the ganged p50 / e2e machinery delta
+        # must sit inside the bounds the record itself carries (gang_e2e.py
+        # self-gates with the same arithmetic; grading it here makes a gang
+        # regression fail the round artifact, not just a unit test).
+        want = r.get("n", 0) + r.get("burst", 0)
+        machinery = r.get("machinery_added_p50_ms")
+        ok = (r.get("gang_engaged") is True
+              and r.get("ganged_errors", 1) == 0
+              and r.get("plain_errors", 1) == 0
+              and r.get("ganged_ok") == want and r.get("plain_ok") == want
+              and (r.get("ganged_p50_ms") or 1e9) <= r.get("p50_bound_ms", 500)
+              and machinery is not None
+              and machinery <= r.get("machinery_bound_ms", 400))
+        row("gang_e2e", ok,
+            f"gang {r.get('gang')}: ganged p50 {r.get('ganged_p50_ms')} ms, "
+            f"machinery +{machinery} ms, errors "
+            f"{r.get('ganged_errors')}/{r.get('plain_errors')}")
+    else:
+        row("gang_e2e", None, "no fresh record")
+
+    r, crash = crit("soak")
+    if crash:
+        row("soak", False, crash)
+    elif r:
         # soak.py self-gates (rc 1 on error/leak); mirror it so a soak that
         # recorded a nonzero error or leaked job can never read as PASS.
         row("soak", r.get("error", 1) == 0 and r.get("leaks", 1) == 0,

@@ -230,6 +230,48 @@ def test_unreadable_invalidation_list_warns_loudly(tmp_path):
     assert rows["latency_mesh1"][0] == "PASS"
 
 
+def test_crashed_criteria_step_grades_fail_not_absent(tmp_path):
+    # A step that died before printing its result JSON (rc != 0, no
+    # "result") is a regression that crashed instead of degrading; absent
+    # would not count toward the exit code and the artifact would read
+    # clean. "yielded" (killed for a driver bench) stays absent.
+    crashed = {"rc": 1, "stderr_tail": ["AssertionError: mesh missing"]}
+    proc, rows = summarize(tmp_path, {"gang_e2e": dict(crashed),
+                                      "flood": dict(crashed),
+                                      "soak": dict(crashed)})
+    for name in ("gang_e2e", "flood", "soak"):
+        assert rows[name][0] == "FAIL", rows[name]
+    assert proc.returncode == 1
+    _, rows = summarize(tmp_path, {"flood": {"rc": "yielded"}})
+    assert rows["flood"][0] == "absent"
+
+
+def test_gang_e2e_gates_on_engagement_and_bounds(tmp_path):
+    good = {"rc": 0, "result": {
+        "gang": 8, "n": 12, "burst": 6, "gang_engaged": True,
+        "ganged_ok": 18, "plain_ok": 18, "ganged_errors": 0,
+        "plain_errors": 0, "ganged_p50_ms": 64.1, "plain_p50_ms": 10.9,
+        "machinery_added_p50_ms": 53.2,
+        "p50_bound_ms": 500.0, "machinery_bound_ms": 400.0}}
+    _, rows = summarize(tmp_path, {"gang_e2e": good})
+    assert rows["gang_e2e"][0] == "PASS"
+    # The r4 failure mode: the mesh guard silently not engaging the gang.
+    bad = json.loads(json.dumps(good))
+    bad["result"]["gang_engaged"] = False
+    _, rows = summarize(tmp_path, {"gang_e2e": bad})
+    assert rows["gang_e2e"][0] == "FAIL"
+    # Machinery blowing its bound (the record carries its own bound).
+    bad = json.loads(json.dumps(good))
+    bad["result"]["machinery_added_p50_ms"] = 450.0
+    _, rows = summarize(tmp_path, {"gang_e2e": bad})
+    assert rows["gang_e2e"][0] == "FAIL"
+    # A dropped request (ok != n + burst) in either mode.
+    bad = json.loads(json.dumps(good))
+    bad["result"]["plain_ok"] = 17
+    _, rows = summarize(tmp_path, {"gang_e2e": bad})
+    assert rows["gang_e2e"][0] == "FAIL"
+
+
 def test_soak_gates_on_errors_and_leaks(tmp_path):
     rec = {"rc": 0, "result": {"ops": 160, "ok": 160, "error": 0,
                                "leaks": 0, "ok_per_sec": 18.0}}
