@@ -19,6 +19,8 @@ Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
   tests_tpu  rc 0
   soak       zero errors and zero leaked jobs
   gang_e2e   gang engaged, all requests validate, p50/machinery in-bounds
+  yield_drill driver's exact command rc 0 on tpu in <=120 s THROUGH a
+             yielding capture, announce flag cleaned up after
   gang_ab    machinery delta reported (informational)
 
 Invalidated records (VERDICT r4 item 4): a capture record the docs have
@@ -289,6 +291,20 @@ def main() -> int:
             f"{r.get('ganged_errors')}/{r.get('plain_errors')}")
     else:
         row("gang_e2e", None, "no fresh record")
+
+    r, crash = crit("yield_drill")
+    if crash:
+        row("yield_drill", False, crash)
+    elif r:
+        # The chip-yield protocol exercised for real: a concurrent capture
+        # must yield and the driver's exact command must land rc 0 on TPU
+        # inside its shortest budget. The record's own ok folds all of it.
+        row("yield_drill", r.get("ok") is True,
+            f"driver rc={r.get('driver_rc')} in {r.get('driver_seconds')}s "
+            f"on {r.get('driver_platform')}, holder_yielded="
+            f"{r.get('holder_yielded')}")
+    else:
+        row("yield_drill", None, "no fresh record")
 
     r, crash = crit("soak")
     if crash:

@@ -97,14 +97,45 @@ while true; do
             --steps "$STEPS" --mark "$MARK" $skip_flag
         rc=$?
         if [ "$rc" -ne 3 ]; then
-            echo "$(date -u +%FT%TZ) capture done (rc=$rc); timing a cold-process bench.py (compile-cache proof)"
-            start=$(date +%s)
-            python bench.py
-            echo "cold_bench_seconds=$(( $(date +%s) - start ))"
-            echo "$(date -u +%FT%TZ) watcher done"
-            exit 0
+            # Chip idle, cache warm: the exact state a driver-slot run would
+            # find. Drill the yield protocol (VERDICT r4 item 2) — a capture
+            # holding the chip while the driver's exact command must still
+            # land rc 0 on TPU inside its 120 s budget. rc 3 = tunnel died
+            # under the drill: keep watching, the drill self-skips once ok.
+            echo "$(date -u +%FT%TZ) capture done (rc=$rc); running chip-yield drill"
+            python benchmarks/yield_drill.py --mark "$MARK"
+            drc=$?
+            if [ "$drc" -eq 3 ]; then
+                echo "$(date -u +%FT%TZ) drill interrupted by tunnel death; resuming watch"
+            elif [ "$drc" -ne 0 ]; then
+                # rc 0 covers both verdicts (the record says ok true/false);
+                # anything else means the drill CRASHED before recording.
+                # Retry on later windows, but cap it — a persistently
+                # crashing drill must not block the cold-bench proof forever,
+                # and its absence from the record is itself visible (the
+                # summarizer grades yield_drill absent).
+                drill_fails=$(( ${drill_fails:-0} + 1 ))
+                if [ "$drill_fails" -lt 2 ]; then
+                    echo "$(date -u +%FT%TZ) drill crashed (rc=$drc, attempt $drill_fails); will retry next window"
+                else
+                    echo "$(date -u +%FT%TZ) drill crashed again (rc=$drc); giving up on the drill, finishing watcher"
+                    start=$(date +%s)
+                    python bench.py
+                    echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+                    echo "$(date -u +%FT%TZ) watcher done (drill unrecorded)"
+                    exit 1
+                fi
+            else
+                echo "$(date -u +%FT%TZ) drill done (rc=$drc); timing a cold-process bench.py (compile-cache proof)"
+                start=$(date +%s)
+                python bench.py
+                echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+                echo "$(date -u +%FT%TZ) watcher done"
+                exit 0
+            fi
+        else
+            echo "$(date -u +%FT%TZ) capture interrupted by tunnel death; resuming watch"
         fi
-        echo "$(date -u +%FT%TZ) capture interrupted by tunnel death; resuming watch"
     fi
     echo "$(date -u +%FT%TZ) tunnel down; retry in ${PROBE_INTERVAL}s"
     sleep "$PROBE_INTERVAL"
