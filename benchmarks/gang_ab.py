@@ -17,6 +17,21 @@ Both sides run the SAME engine, difficulty, and geometry; kernel launches
 differ only in the mesh. Uses direct kernel-path launches (not the full
 backend) so the A/B isolates the launch machinery from engine scheduling.
 
+Second measurement (VERDICT r4 item 7): the RESIDENT-LOOP window sweep.
+The 8-chip projection's last soft term was the per-window cost of
+sharded_search_run's device-resident while_loop (loop bookkeeping +
+per-window pmin), measured only on virtual CPU (4.6 ms/window — collective-
+dominated, a host artifact). Here the SAME sharded_search_run runs on the
+real chip at gang=1 across max_steps 1/2/4/8/16 with an unreachable
+difficulty and a scan-negligible window (~0.24 ms of scan at flagship
+rate), so
+
+    (t[16] - t[1]) / 15  =  marginal ms per extra resident window
+
+is a REAL-SILICON number for everything in the loop except the physical
+ICI hop of the per-window pmin — which is the one remaining (physical,
+~10-30 us on v5e) estimate in the projection.
+
 Usage: python benchmarks/gang_ab.py [--reps 20]
 """
 
@@ -73,17 +88,39 @@ def run(reps: int) -> None:
             sublanes=sublanes, iters=iters, nblocks=nblocks, group=group,
         )
 
-    results = {}
-    for name, fn in (("plain", plain), ("ganged_1", ganged)):
+    def time_p50_ms(fn) -> float:
         np.asarray(fn())  # compile + warm
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
             np.asarray(fn())
             times.append(time.perf_counter() - t0)
-        results[name] = times
+        return float(np.percentile(times, 50)) * 1e3
 
-    p50 = {k: float(np.percentile(v, 50)) * 1e3 for k, v in results.items()}
+    p50 = {"plain": time_p50_ms(plain), "ganged_1": time_p50_ms(ganged)}
+
+    # Resident-loop window sweep at gang=1 (projection item: per-window
+    # loop cost on real silicon). Scan-negligible window; unreachable
+    # difficulty holds the while_loop at exactly max_steps windows.
+    from tpu_dpow.parallel import sharded_search_run
+
+    if on_tpu:
+        w_sublanes, w_iters = 32, 64  # 262k nonces ≈ 0.24 ms of scan
+    else:
+        w_sublanes, w_iters = 8, 8
+    w_chunk = w_sublanes * 128 * w_iters
+    window_p50 = {}
+    for steps in (1, 2, 4, 8, 16):
+        def resident(steps=steps):
+            lo, _ = sharded_search_run(
+                params, mesh=mesh, chunk_per_shard=w_chunk, kernel=kernel,
+                sublanes=w_sublanes, iters=w_iters, nblocks=1, group=1,
+                max_steps=steps,
+            )
+            return lo
+
+        window_p50[steps] = round(time_p50_ms(resident), 3)
+
     print(json.dumps({
         "bench": "gang_machinery_ab",
         "platform": dev.platform,
@@ -92,6 +129,10 @@ def run(reps: int) -> None:
         "plain_p50_ms": round(p50["plain"], 3),
         "ganged1_p50_ms": round(p50["ganged_1"], 3),
         "machinery_ms": round(p50["ganged_1"] - p50["plain"], 3),
+        "resident_window_chunk": w_chunk,
+        "resident_window_p50_ms": window_p50,
+        "resident_marginal_ms_per_window": round(
+            (window_p50[16] - window_p50[1]) / 15, 4),
     }))
 
 
