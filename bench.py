@@ -313,6 +313,25 @@ def main() -> int:
             "vs_baseline": 0.0,
             "error": "all measurement attempts failed or timed out",
         }
+    if result.get("platform") != "tpu":
+        # A non-TPU artifact (CPU fallback or the value-0 error record)
+        # must still point at the committed TPU evidence: the last
+        # trustworthy on-chip headline (invalidation-aware helper in
+        # benchmarks/roofline.py) with its mark, so the driver-slot record
+        # carries provenance even when the tunnel is dead all round.
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+            from roofline import measured_headline_hs
+
+            hs, mark = measured_headline_hs()
+            if hs:
+                result["last_tpu_capture"] = {
+                    "value": hs, "unit": "H/s", "mark": mark,
+                    "source": "BENCH_latency.json headline",
+                }
+        except Exception:
+            pass
     if attempts:
         result["attempts"] = attempts
     # A SIGTERM from here on must not append a value-0 line AFTER the real
