@@ -84,6 +84,23 @@ def test_failed_step_with_dead_tunnel_aborts_rc3(tmp_path):
     assert "capture_finished_unix" not in data
 
 
+def test_cpu_only_step_failure_never_blamed_on_tunnel(tmp_path):
+    # gang_e2e pins itself to CPU and cannot depend on the tunnel: its
+    # failure is a real regression. The dead-tunnel abort must NOT swallow
+    # it (that path skips the attempts increment, so the capture would
+    # re-run and re-abort every window, starving the steps below it).
+    steps = [fail_step("gang_e2e"), ok_step("after")]
+    prior = {"gang_e2e": {"rc": 1, "mark": "t1", "attempts": 1}}
+    proc, data = run_capture(
+        tmp_path, steps, ["--mark", "t1", "--skip_fresh"], prior=prior)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert data["gang_e2e"]["rc"] == 1
+    assert data["gang_e2e"]["attempts"] == 2   # a live failure, counted
+    assert data["after"]["rc"] == 0            # capture continued past it
+    assert "capture_finished_unix" in data
+    assert "capture_aborted_dead_tunnel_unix" not in data
+
+
 def test_retry_capped_step_deferred_to_end(tmp_path):
     # A step that keeps failing on a live tunnel must not livelock the
     # resume loop — but it must not be dropped forever either (a flapping
