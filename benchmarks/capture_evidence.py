@@ -38,6 +38,11 @@ STEPS = [
     ("headline", [sys.executable, "bench.py"], 900),
     ("tests_tpu", [sys.executable, "-m", "pytest", "tests_tpu", "-q",
                    "--no-header", "-p", "no:cacheprovider"], 1200),
+    # CPU-only (env-wrapped like gang_e2e): derives ops/hash + VPU ceiling
+    # and reads the headline just captured above, so the artifact carries
+    # the MFU of the FRESH number, not a doc citation.
+    ("roofline", ["env", "PYTHONPATH=", "JAX_PLATFORMS=cpu",
+                  sys.executable, "benchmarks/roofline.py"], 300),
     ("latency_base", [sys.executable, "benchmarks/latency.py", "--n", "20"], 600),
     ("latency_8x", [sys.executable, "benchmarks/latency.py", "--n", "10",
                     "--multiplier", "8"], 900),
@@ -77,7 +82,7 @@ AXON_SITE = "/root/.axon_site"
 # is a real failure, not tunnel weather — the dead-tunnel abort must not
 # swallow it (it skips the attempts increment, so a genuine regression
 # would re-run and re-abort every window, starving the steps below it).
-CPU_ONLY_STEPS = {"gang_e2e"}
+CPU_ONLY_STEPS = {"gang_e2e", "roofline"}
 # A resumed capture re-runs a previously failed step at most this many times
 # before skipping past it (see the retry-cap comment in main()).
 MAX_STEP_ATTEMPTS = 2

@@ -100,17 +100,23 @@ def count_ops_per_hash() -> dict:
 def measured_headline_hs() -> "tuple[float, str | None] | tuple[None, None]":
     """Latest trustworthy TPU headline: (H/s, mark) or (None, None).
 
-    Honors benchmarks/invalidated.json the same way summarize_capture.py
-    does — an MFU derived from a disavowed record would be exactly the
-    false evidence the invalidation list exists to block.
+    Reads the same artifact the enclosing capture writes (the
+    TPU_DPOW_BENCH_OUT override capture_evidence honors, else the repo
+    file) and applies the same trust rules the summarizer does: a record
+    whose rc isn't 0 is a crash whose partial result the grader refuses,
+    and benchmarks/invalidated.json disavowals are honored — an MFU
+    derived from either would be exactly the false evidence those
+    mechanisms exist to block.
     """
+    path = (os.environ.get("TPU_DPOW_BENCH_OUT")
+            or os.path.join(REPO, "BENCH_latency.json"))
     try:
-        with open(os.path.join(REPO, "BENCH_latency.json")) as f:
+        with open(path) as f:
             data = json.load(f)
     except (OSError, json.JSONDecodeError):
         return None, None
     rec = data.get("headline")
-    if not isinstance(rec, dict):
+    if not isinstance(rec, dict) or rec.get("rc", 0) != 0:
         return None, None
     import summarize_capture as sc
 

@@ -318,7 +318,7 @@ def main() -> int:
     else:
         row("soak", None, "no fresh record")
 
-    for informational in ("gang_ab", "latency_mesh1", "latency_base",
+    for informational in ("roofline", "gang_ab", "latency_mesh1", "latency_base",
                           "latency_8x", "latency_base_x2ladder", "overhead",
                           "chaos_crossproc", "throughput_sweep"):
         r = res(step(informational))

@@ -33,7 +33,7 @@ if [ -d /root/.axon_site ]; then
     esac
 fi
 MARK="${1:-capture}"
-STEPS="${CAPTURE_STEPS:-headline,tests_tpu,latency_base,latency_base_x2ladder,flood,batch,fairness,precache,cancel,gang_ab,gang_e2e,latency_mesh1,overhead,latency_8x,soak,chaos_crossproc,throughput_sweep}"
+STEPS="${CAPTURE_STEPS:-headline,roofline,tests_tpu,latency_base,latency_base_x2ladder,flood,batch,fairness,precache,cancel,gang_ab,gang_e2e,latency_mesh1,overhead,latency_8x,soak,chaos_crossproc,throughput_sweep}"
 # Live windows as short as ~2 min have been observed (r4: live 01:00:58Z,
 # dead by 01:01:28Z). A live probe completes in ~15 s, so a 75 s bound is
 # generous; a short interval keeps the probe cycle (~2 min when down) from
@@ -122,6 +122,8 @@ while true; do
                     start=$(date +%s)
                     python bench.py
                     echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+                    echo "$(date -u +%FT%TZ) graded summary (mark=$MARK):"
+                    PYTHONPATH= python benchmarks/summarize_capture.py --mark "$MARK" || true
                     echo "$(date -u +%FT%TZ) watcher done (drill unrecorded)"
                     exit 1
                 fi
@@ -130,6 +132,10 @@ while true; do
                 start=$(date +%s)
                 python bench.py
                 echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+                # Leave the graded gap list in the log: the capture's whole
+                # point is this table reading all-PASS.
+                echo "$(date -u +%FT%TZ) graded summary (mark=$MARK):"
+                PYTHONPATH= python benchmarks/summarize_capture.py --mark "$MARK" || true
                 echo "$(date -u +%FT%TZ) watcher done"
                 exit 0
             fi
