@@ -76,6 +76,45 @@ probe() {
         python benchmarks/capture_evidence.py --probe
 }
 
+# A fresh rc-0 headline under this mark — i.e. the compile cache is warm
+# for the bench shapes the drill's 120 s driver budget depends on.
+headline_fresh() {
+    PYTHONPATH= python - "$MARK" <<'EOF'
+import json, sys
+try:
+    rec = json.load(open("BENCH_latency.json")).get("headline") or {}
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("mark") == sys.argv[1] else 1)
+EOF
+}
+
+# Shared drill invocation: returns 0 recorded (ok true OR false — the
+# record says which), 3 tunnel died, 1 crashed before recording (counted
+# into the shared drill_fails cap so the two call sites can't diverge).
+run_drill() {
+    python benchmarks/yield_drill.py --mark "$MARK" "$@"
+    local drc=$?
+    [ "$drc" -eq 0 ] && return 0
+    [ "$drc" -eq 3 ] && return 3
+    drill_fails=$(( ${drill_fails:-0} + 1 ))
+    echo "$(date -u +%FT%TZ) drill crashed (rc=$drc, crash #$drill_fails)"
+    return 1
+}
+
+# Terminal sequence once the capture is complete: cold-bench the compile
+# cache, leave the graded gap list in the log (the capture's whole point
+# is that table reading all-PASS), and announce.
+finish_watcher() {
+    echo "$(date -u +%FT%TZ) timing a cold-process bench.py (compile-cache proof)"
+    local start=$(date +%s)
+    python bench.py
+    echo "cold_bench_seconds=$(( $(date +%s) - start ))"
+    echo "$(date -u +%FT%TZ) graded summary (mark=$MARK):"
+    PYTHONPATH= python benchmarks/summarize_capture.py --mark "$MARK" || true
+    echo "$(date -u +%FT%TZ) $1"
+}
+
 while true; do
     if probe; then
         echo "$(date -u +%FT%TZ) tunnel LIVE -> capturing (mark=$MARK steps=$STEPS)"
@@ -93,50 +132,75 @@ while true; do
         # SKIP_FRESH=0 only with a short step list, where re-running the
         # already-landed steps next window costs minutes, not the capture.
         [ "${SKIP_FRESH:-1}" = "0" ] && skip_flag=""
+        # Priority phases for short windows (observed as brief as ~2 min):
+        #   A. headline (+roofline off its fresh number) — the round's top
+        #      artifact, and it warms the compile cache for the drill;
+        #   B. the chip-yield drill (--skip_recorded: a verdict already on
+        #      file, ok OR false, must not burn ~4 min at every window
+        #      head — only the post-capture pass re-litigates a false);
+        #   C. everything else, then the post-capture drill pass.
+        # All phases are --skip_fresh idempotent, so a window that dies
+        # mid-phase resumes exactly where it stopped on the next one.
+        # (Forced SKIP_FRESH=0 re-captures skip the phase split: the short
+        # step list IS the priority.)
+        if [ -n "$skip_flag" ] && [ "${CAPTURE_STEPS:-}" = "" ]; then
+            python benchmarks/capture_evidence.py \
+                --steps headline,roofline --mark "$MARK" $skip_flag
+            arc=$?
+            if [ "$arc" -eq 3 ]; then
+                echo "$(date -u +%FT%TZ) headline phase interrupted; resuming watch"
+                echo "$(date -u +%FT%TZ) tunnel down; retry in ${PROBE_INTERVAL}s"
+                sleep "$PROBE_INTERVAL"
+                continue
+            fi
+            # Phase B only when the cache is actually warm (a fresh rc-0
+            # headline under this mark): drilling the driver's 120 s budget
+            # against a cold XLA compile would record a false protocol
+            # failure caused by our own sequencing.
+            if [ "$arc" -eq 0 ] && headline_fresh; then
+                echo "$(date -u +%FT%TZ) headline fresh; chip-yield drill (phase B)"
+                run_drill --skip_recorded
+                bdrc=$?
+                if [ "$bdrc" -eq 3 ]; then
+                    echo "$(date -u +%FT%TZ) drill interrupted by tunnel death; resuming watch"
+                    echo "$(date -u +%FT%TZ) tunnel down; retry in ${PROBE_INTERVAL}s"
+                    sleep "$PROBE_INTERVAL"
+                    continue
+                fi
+                # Crash (counted in run_drill): fall through to phase C;
+                # the post-capture pass retries under the shared cap.
+            fi
+        fi
         python benchmarks/capture_evidence.py \
             --steps "$STEPS" --mark "$MARK" $skip_flag
         rc=$?
         if [ "$rc" -ne 3 ]; then
             # Chip idle, cache warm: the exact state a driver-slot run would
-            # find. Drill the yield protocol (VERDICT r4 item 2) — a capture
-            # holding the chip while the driver's exact command must still
-            # land rc 0 on TPU inside its 120 s budget. rc 3 = tunnel died
-            # under the drill: keep watching, the drill self-skips once ok.
+            # find. Post-capture drill pass: retries a recorded false
+            # verdict too (a false from a cold cache or dying window can
+            # flip true on a healthy chip). rc 3 = tunnel died under the
+            # drill: keep watching; the drill self-skips once ok.
             echo "$(date -u +%FT%TZ) capture done (rc=$rc); running chip-yield drill"
-            python benchmarks/yield_drill.py --mark "$MARK"
+            run_drill
             drc=$?
             if [ "$drc" -eq 3 ]; then
                 echo "$(date -u +%FT%TZ) drill interrupted by tunnel death; resuming watch"
             elif [ "$drc" -ne 0 ]; then
-                # rc 0 covers both verdicts (the record says ok true/false);
-                # anything else means the drill CRASHED before recording.
-                # Retry on later windows, but cap it — a persistently
-                # crashing drill must not block the cold-bench proof forever,
-                # and its absence from the record is itself visible (the
-                # summarizer grades yield_drill absent).
-                drill_fails=$(( ${drill_fails:-0} + 1 ))
-                if [ "$drill_fails" -lt 2 ]; then
-                    echo "$(date -u +%FT%TZ) drill crashed (rc=$drc, attempt $drill_fails); will retry next window"
+                # Crashed before recording (counted in run_drill). Retry on
+                # later windows, but cap it — a persistently crashing drill
+                # must not block the cold-bench proof forever, and its
+                # absence from the record is itself visible (the summarizer
+                # grades yield_drill absent).
+                if [ "${drill_fails:-0}" -lt 2 ]; then
+                    echo "$(date -u +%FT%TZ) will retry the drill next window"
                 else
-                    echo "$(date -u +%FT%TZ) drill crashed again (rc=$drc); giving up on the drill, finishing watcher"
-                    start=$(date +%s)
-                    python bench.py
-                    echo "cold_bench_seconds=$(( $(date +%s) - start ))"
-                    echo "$(date -u +%FT%TZ) graded summary (mark=$MARK):"
-                    PYTHONPATH= python benchmarks/summarize_capture.py --mark "$MARK" || true
-                    echo "$(date -u +%FT%TZ) watcher done (drill unrecorded)"
+                    echo "$(date -u +%FT%TZ) drill crash cap reached; giving up on the drill, finishing watcher"
+                    finish_watcher "watcher done (drill unrecorded)"
                     exit 1
                 fi
             else
-                echo "$(date -u +%FT%TZ) drill done (rc=$drc); timing a cold-process bench.py (compile-cache proof)"
-                start=$(date +%s)
-                python bench.py
-                echo "cold_bench_seconds=$(( $(date +%s) - start ))"
-                # Leave the graded gap list in the log: the capture's whole
-                # point is this table reading all-PASS.
-                echo "$(date -u +%FT%TZ) graded summary (mark=$MARK):"
-                PYTHONPATH= python benchmarks/summarize_capture.py --mark "$MARK" || true
-                echo "$(date -u +%FT%TZ) watcher done"
+                echo "$(date -u +%FT%TZ) drill done"
+                finish_watcher "watcher done"
                 exit 0
             fi
         else
