@@ -58,6 +58,10 @@ if ! PYTHONPATH= timeout 60 \
 fi
 
 probe() {
+    # Test-only override: lets a bounded smoke run exercise the phased
+    # live-window flow on CPU (with TPU_DPOW_BENCH_OUT pointed at a temp
+    # artifact) without a tunnel. Never set this in production.
+    [ "${TPU_DPOW_WATCH_ASSUME_LIVE:-0}" = "1" ] && return 0
     # Shared with capture_evidence.py's mid-capture liveness check so the
     # two can never disagree about what "alive" means; both honor the same
     # PROBE_TIMEOUT env. The outer timeout backstops the parent process
@@ -77,12 +81,15 @@ probe() {
 }
 
 # A fresh rc-0 headline under this mark — i.e. the compile cache is warm
-# for the bench shapes the drill's 120 s driver budget depends on.
+# for the bench shapes the drill's 120 s driver budget depends on. Reads
+# the same artifact the capture writes (TPU_DPOW_BENCH_OUT override or the
+# repo file).
 headline_fresh() {
     PYTHONPATH= python - "$MARK" <<'EOF'
-import json, sys
+import json, os, sys
+path = os.environ.get("TPU_DPOW_BENCH_OUT") or "BENCH_latency.json"
 try:
-    rec = json.load(open("BENCH_latency.json")).get("headline") or {}
+    rec = json.load(open(path)).get("headline") or {}
 except Exception:
     sys.exit(1)
 sys.exit(0 if rec.get("rc") == 0 and rec.get("mark") == sys.argv[1] else 1)
