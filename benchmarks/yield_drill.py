@@ -53,6 +53,19 @@ HOLDER_N = os.environ.get("TPU_DPOW_DRILL_HOLDER_N", "500")
 SETTLE_S = float(os.environ.get("TPU_DPOW_DRILL_SETTLE_S", "30"))
 
 
+def _tunnel_alive() -> bool:
+    """The drill's dead-tunnel veto, honoring the watcher's smoke knob.
+
+    TPU_DPOW_WATCH_ASSUME_LIVE=1 (test-only) must bypass this veto too —
+    otherwise a CPU smoke run's drill always exits rc 3 (genuinely dead
+    tunnel) and the watcher's phased flow can never reach its terminal
+    sequence in a bounded smoke.
+    """
+    if os.environ.get("TPU_DPOW_WATCH_ASSUME_LIVE") == "1":
+        return True
+    return ce.tunnel_alive()
+
+
 def fresh_verdict(out_path: str, mark: str | None):
     """The recorded drill verdict under this mark: True, False, or None.
 
@@ -144,7 +157,12 @@ def main() -> int:
                    "under this mark — the watcher's window-head phase; the "
                    "default retries a recorded false")
     args = p.parse_args()
-    out_path = args.out or os.path.join(REPO, "BENCH_latency.json")
+    # Same artifact resolution as capture_evidence.py: the env override
+    # exists for tests/smokes that must not touch the repo artifact, and a
+    # drill run inside such a session must read its skip-verdict from and
+    # write its record to the same file the capture used.
+    out_path = (args.out or os.environ.get("TPU_DPOW_BENCH_OUT")
+                or os.path.join(REPO, "BENCH_latency.json"))
     verdict = fresh_verdict(out_path, args.mark)
     if verdict is True or (args.skip_recorded and verdict is not None):
         print(f"yield_drill verdict {verdict} already recorded under mark "
@@ -180,7 +198,7 @@ def _drill(args, out_path: str, tmpdir: str) -> int:
         print("holder never reached its step; aborting drill")
         print("".join(holder_out)[-2000:])
         _kill(holder)
-        return 3 if not ce.tunnel_alive() else 1
+        return 3 if not _tunnel_alive() else 1
     time.sleep(SETTLE_S)
 
     t_drill = time.time()
@@ -224,7 +242,7 @@ def _drill(args, out_path: str, tmpdir: str) -> int:
     if args.mark:
         record["mark"] = args.mark
     print(json.dumps(record["result"]))
-    if not ok and not ce.tunnel_alive():
+    if not ok and not _tunnel_alive():
         # Dead tunnel explains any of the failures above; don't record a
         # false negative — let the watcher re-run on the next window.
         print("drill failed with a dead tunnel; not recording (rc 3)")

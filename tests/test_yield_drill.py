@@ -32,6 +32,43 @@ def test_fresh_ok_matches_mark_and_ok(tmp_path):
     assert not yield_drill.fresh_ok(str(tmp_path / "absent.json"), "r5")
 
 
+def test_failed_drill_on_dead_tunnel_returns_3_without_recording(
+        tmp_path, monkeypatch):
+    """A drill failure a dead tunnel explains must NOT record a false
+    negative: rc 3 tells the watcher to resume and retry next window."""
+    import subprocess
+
+    monkeypatch.setattr(yield_drill, "SETTLE_S", 0.5)
+
+    # The rc-3 decision is pure logic over the driver result + the tunnel
+    # veto; the real holder mechanics are covered by the yield test below.
+    # A stub holder (prints the step line, exits 3 on its own) keeps this
+    # test at seconds, not a second full capture subprocess.
+    def stub_holder(tmpdir):
+        return subprocess.Popen(
+            [sys.executable, "-c",
+             "import time; print('== hold: stub', flush=True); "
+             "time.sleep(3); raise SystemExit(3)"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            start_new_session=True)
+
+    monkeypatch.setattr(yield_drill, "start_holder", stub_holder)
+
+    def stub_driver():
+        # The driver's 120 s budget expired with a CPU fallback number —
+        # the smoke-observed shape of a drill run during an outage.
+        return {"rc": 124, "seconds": 120.0,
+                "result": {"platform": "cpu", "value": 9e5}}
+
+    monkeypatch.setattr(yield_drill, "run_driver_sim", stub_driver)
+    monkeypatch.setattr(yield_drill.ce, "tunnel_alive", lambda *a, **k: False)
+    out = tmp_path / "bench.json"
+    monkeypatch.setattr(
+        sys, "argv", ["yield_drill.py", "--mark", "t", "--out", str(out)])
+    assert yield_drill.main() == 3
+    assert not out.exists() or "yield_drill" not in json.loads(out.read_text())
+
+
 def test_drill_yields_real_holder_to_announced_driver(tmp_path, monkeypatch):
     """Full drill mechanics on CPU: real holder capture, stubbed driver."""
     from tpu_dpow.utils import (announce_foreign_chip_user,
