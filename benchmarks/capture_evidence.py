@@ -23,6 +23,8 @@ from __future__ import annotations
 import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 import argparse
+import contextlib
+import fcntl
 import json
 import os
 import subprocess
@@ -33,6 +35,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Env override exists for the test suite (and ad-hoc captures that must not
 # touch the repo artifact).
 OUT = os.environ.get("TPU_DPOW_BENCH_OUT") or os.path.join(REPO, "BENCH_latency.json")
+
+
+class ArtifactBusy(Exception):
+    """Another writer holds the artifact lock (a capture is mid-flight)."""
+
+
+@contextlib.contextmanager
+def artifact_lock(path: str, blocking: bool = True):
+    """Advisory flock serializing writers of one evidence artifact.
+
+    The capture holds it for its whole run; yield_drill.py takes the SAME
+    lock around its read-modify-write of the shared file (and refuses to
+    start while a capture is mid-flight), so a manually launched drill can
+    no longer race a capture and silently lose one writer's update
+    (ADVICE r5). The lock file lives next to the artifact (``<path>.lock``)
+    so distinct artifacts — e.g. the drill's temp inner capture — never
+    contend. Python opens the fd close-on-exec, so step children do not
+    inherit (and thus cannot prolong) the capture's hold.
+    """
+    fh = open(path + ".lock", "w")
+    try:
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | (0 if blocking else fcntl.LOCK_NB))
+        except OSError as e:
+            raise ArtifactBusy(f"{path}.lock: {e}") from e
+        yield
+    finally:
+        try:
+            fcntl.flock(fh, fcntl.LOCK_UN)
+        finally:
+            fh.close()
 
 STEPS = [
     ("headline", [sys.executable, "bench.py"], 900),
@@ -339,6 +372,19 @@ def main() -> int:
         # revision and silently publish stale numbers as a clean finish.
         print("--skip_fresh requires --mark", file=sys.stderr)
         return 2
+    # One writer per artifact: hold the lock for the whole capture so a
+    # concurrently launched drill or second capture cannot interleave its
+    # read-modify-write with this run's progressive saves.
+    try:
+        with artifact_lock(OUT, blocking=False):
+            return _run_capture(args, steps)
+    except ArtifactBusy as e:
+        print(f"artifact busy ({e}): another capture/drill is mid-flight "
+              "on the same file; refusing a concurrent run", file=sys.stderr)
+        return 2
+
+
+def _run_capture(args, steps) -> int:
     results = load()
     if args.skip_fresh and "capture_started_unix" in results:
         # Preserve the original start time across resumed windows; log the

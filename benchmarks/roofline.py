@@ -120,7 +120,13 @@ def measured_headline_hs() -> "tuple[float, str | None] | tuple[None, None]":
         return None, None
     import summarize_capture as sc
 
-    if sc.invalidation_reason("headline", rec, sc.load_invalidations()):
+    try:
+        invalidations = sc.load_invalidations()
+    except sc.InvalidationsUnreadable:
+        # Fail closed with the summarizer: an unreadable disavowal list
+        # means this headline cannot be proven trustworthy.
+        return None, None
+    if sc.invalidation_reason("headline", rec, invalidations):
         return None, None
     r = sc.res(rec)
     if r.get("platform") == "tpu" and r.get("value"):
@@ -145,6 +151,15 @@ def main() -> None:
         "vpu_ops_per_sec": round(V5E_VPU_OPS_PER_SEC, 0),
         "ceiling_hs": round(ceiling_hs, 0),
         "ceiling_ghs": round(ceiling_hs / 1e9, 3),
+        # The ceiling (and the MFU computed from it below) rests on
+        # unverifiable hardware assumptions — clock back-derived from the
+        # published MXU peak, (8,128)x4 ALU geometry, one u32 op per ALU
+        # per cycle — so these fields are ESTIMATES, not measurements
+        # (ADVICE r5). measured_hs alone is a measurement.
+        "derived": True,
+        "uncertainty": "ceiling_hs/mfu are estimates: clock and VPU ALU "
+                       "geometry are derived, not published; treat as an "
+                       "order-of-magnitude bound, not a measured fact",
     }
     if args.hs is not None:
         hs, mark = args.hs, "override"

@@ -49,6 +49,17 @@ def res(record):
     return (record or {}).get("result") or {}
 
 
+class InvalidationsUnreadable(Exception):
+    """The invalidation list exists but cannot be parsed.
+
+    Fail CLOSED (ADVICE r5): the old warn-and-continue meant a truncated /
+    merge-conflicted list silently re-enabled PASS for every disavowed
+    record in any pipeline that logs stdout nobody reads. Callers must
+    treat affected records as un-gradable (stale) or exit nonzero, never
+    grade them PASS.
+    """
+
+
 def load_invalidations(path=None):
     """Declarative list of disavowed records (benchmarks/invalidated.json).
 
@@ -58,6 +69,10 @@ def load_invalidations(path=None):
     match field equals the record's result value — the fingerprint is what
     lets a re-capture under the same mark supersede the entry without
     editing this file.
+
+    A list that exists but cannot be parsed raises InvalidationsUnreadable
+    — the absence of a list is "nothing disavowed", but an unreadable one
+    is "cannot know what is disavowed", which must never grade PASS.
     """
     if path is None:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -68,16 +83,9 @@ def load_invalidations(path=None):
         with open(path) as f:
             entries = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
-        # Fail LOUD, not open: a truncated/merge-conflicted list silently
-        # re-enables PASS for every disavowed record — the exact false
-        # evidence the list exists to block.
-        print(f"WARNING: invalidation list {path} unreadable ({e}); "
-              "NO records will be disavowed", flush=True)
-        return []
+        raise InvalidationsUnreadable(f"{path}: {e}") from e
     if not isinstance(entries, list):
-        print(f"WARNING: invalidation list {path} is not a JSON list; "
-              "ignoring it", flush=True)
-        return []
+        raise InvalidationsUnreadable(f"{path}: not a JSON list")
     kept = []
     for e in entries:
         if not (isinstance(e, dict) and e.get("step") and e.get("match")):
@@ -120,7 +128,17 @@ def main() -> int:
         print(f"no capture to summarize: {e}")
         return 1
 
-    invalidations = load_invalidations(args.invalidated)
+    try:
+        invalidations = load_invalidations(args.invalidated)
+        invalidations_unreadable = None
+    except InvalidationsUnreadable as e:
+        # Fail CLOSED: with the disavowal list unreadable, no record can
+        # prove it is NOT disavowed — every step grades stale and the exit
+        # code is nonzero until the list is fixed (ADVICE r5).
+        invalidations = []
+        invalidations_unreadable = str(e)
+        print(f"ERROR: invalidation list unreadable ({e}); failing closed — "
+              "all steps grade stale until the list is fixed", flush=True)
     stale = {}  # step name -> invalidation reason (for the row printer)
 
     def step(name):
@@ -129,6 +147,11 @@ def main() -> int:
             return None
         if args.mark and rec.get("mark") != args.mark:
             return None  # stale: from a previous revision's capture
+        if invalidations_unreadable is not None:
+            stale[name] = ("invalidation list unreadable "
+                           f"({invalidations_unreadable}); cannot prove "
+                           "this record is not disavowed")
+            return None
         reason = invalidation_reason(name, rec, invalidations)
         if reason is not None:
             stale[name] = reason
@@ -334,7 +357,7 @@ def main() -> int:
     for name, status, detail in rows:
         print(f"{name:<{width}}  {status:<6}  {detail}")
         failures += status == "FAIL"
-    return 1 if failures else 0
+    return 1 if failures or invalidations_unreadable else 0
 
 
 if __name__ == "__main__":

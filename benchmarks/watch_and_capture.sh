@@ -80,10 +80,14 @@ probe() {
         python benchmarks/capture_evidence.py --probe
 }
 
-# A fresh rc-0 headline under this mark — i.e. the compile cache is warm
-# for the bench shapes the drill's 120 s driver budget depends on. Reads
-# the same artifact the capture writes (TPU_DPOW_BENCH_OUT override or the
-# repo file).
+# A fresh rc-0 TPU headline under this mark — i.e. the compile cache is
+# warm for the bench shapes the drill's 120 s driver budget depends on.
+# result.platform must be 'tpu' (mirroring roofline.measured_headline_hs):
+# bench.py exits 0 even on a CPU fallback, and a CPU headline warmed
+# nothing — arming phase B off it would drill the driver budget against a
+# cold TPU compile cache and record a false protocol failure (ADVICE r5).
+# Reads the same artifact the capture writes (TPU_DPOW_BENCH_OUT override
+# or the repo file).
 headline_fresh() {
     PYTHONPATH= python - "$MARK" <<'EOF'
 import json, os, sys
@@ -92,7 +96,9 @@ try:
     rec = json.load(open(path)).get("headline") or {}
 except Exception:
     sys.exit(1)
-sys.exit(0 if rec.get("rc") == 0 and rec.get("mark") == sys.argv[1] else 1)
+result = rec.get("result") or {}
+sys.exit(0 if rec.get("rc") == 0 and rec.get("mark") == sys.argv[1]
+         and result.get("platform") == "tpu" else 1)
 EOF
 }
 

@@ -168,6 +168,17 @@ def main() -> int:
         print(f"yield_drill verdict {verdict} already recorded under mark "
               f"{args.mark!r}; skipping")
         return 0
+    # Refuse to run while a capture is mid-flight on the same artifact
+    # (ADVICE r5): captures hold the artifact lock for their whole run, so
+    # a probe-acquire tells us one is live. rc 3 = "try again later", the
+    # same signal the watcher already handles for a dead tunnel.
+    try:
+        with ce.artifact_lock(out_path, blocking=False):
+            pass
+    except ce.ArtifactBusy as e:
+        print(f"a capture is mid-flight on {out_path} ({e}); "
+              "refusing to race its artifact writes (rc 3)")
+        return 3
 
     tmpdir = tempfile.mkdtemp(prefix="yield_drill_")
     try:
@@ -247,9 +258,17 @@ def _drill(args, out_path: str, tmpdir: str) -> int:
         # false negative — let the watcher re-run on the next window.
         print("drill failed with a dead tunnel; not recording (rc 3)")
         return 3
-    data = _load(out_path)
-    data["yield_drill"] = record
-    _save(out_path, data)
+    # Same lock capture_evidence holds for its runs: the read-modify-write
+    # below must not interleave with a capture's progressive saves.
+    try:
+        with ce.artifact_lock(out_path, blocking=False):
+            data = _load(out_path)
+            data["yield_drill"] = record
+            _save(out_path, data)
+    except ce.ArtifactBusy as e:
+        print(f"a capture started mid-drill on {out_path} ({e}); "
+              "not recording (rc 3)")
+        return 3
     return 0
 
 

@@ -517,6 +517,103 @@ def test_e2e_mqtt_worker_drop_gets_cancel_on_reconnect():
     run(main())
 
 
+def test_e2e_metrics_and_span_chain_for_one_request():
+    """Observability acceptance (ISSUE 1): one in-process HTTP request must
+    (a) bump the ondemand request counter, (b) leave a complete span chain
+    accept → queue → publish → dispatch → pack → device → result → winner,
+    and (c) surface it all — request-latency histogram, per-stage spans,
+    engine batch-occupancy and device-time — as valid Prometheus text on
+    GET /metrics of the server upcheck port."""
+    from tpu_dpow import obs
+
+    async def main():
+        reg = obs.get_registry()
+        tracer = obs.get_tracer()
+        requests_before = reg.counter(
+            "dpow_server_requests_total", labelnames=("work_type",)
+        ).value("ondemand")
+        stage_hist = reg.histogram(
+            "dpow_request_stage_seconds", labelnames=("stage",))
+        stage_counts_before = {
+            s: stage_hist.count_of(s)
+            for s in ("queue", "publish", "dispatch", "pack", "device",
+                      "result", "winner")
+        }
+        broker = Broker()
+        runner, server, store, clients = await start_stack(broker, n_clients=1)
+        try:
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{runner.ports['service']}/service/"
+                h = random_hash()
+                async with http.post(
+                    url, json={"user": "svc", "api_key": "secret", "hash": h}
+                ) as resp:
+                    body = await resp.json()
+                assert "work" in body, body
+
+                # (a) the ondemand counter moved by exactly this request
+                assert reg.counter(
+                    "dpow_server_requests_total", labelnames=("work_type",)
+                ).value("ondemand") == requests_before + 1
+
+                # (b) complete span chain for the request's trace
+                tid = tracer.id_for(h)
+                assert tid is not None
+                stages = [s for s, _ in tracer.get(tid)]
+                for want in ("accept", "queue", "publish", "dispatch",
+                             "pack", "device", "result", "winner"):
+                    assert want in stages, (want, stages)
+                assert stages.index("accept") < stages.index("publish")
+                assert stages.index("publish") < stages.index("result")
+                # ... and each stage observed into the shared histogram
+                for s, before in stage_counts_before.items():
+                    assert stage_hist.count_of(s) > before, s
+
+                # (c) the Prometheus surface on the upcheck port
+                murl = f"http://127.0.0.1:{runner.ports['upcheck']}/metrics"
+                async with http.get(murl) as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+                parsed = obs.parse_text(text)
+                assert any(
+                    labels.get("work_type") == "ondemand" and value >= 1
+                    for labels, value in parsed["dpow_server_requests_total"]
+                )
+                # request-latency histogram present and populated
+                assert any(
+                    labels.get("work_type") == "ondemand" and value >= 1
+                    for labels, value in parsed["dpow_server_request_seconds_count"]
+                )
+                # per-stage spans on the wire
+                wire_stages = {
+                    labels["stage"]
+                    for labels, value in parsed["dpow_request_stage_seconds_count"]
+                    if value >= 1
+                }
+                for want in ("queue", "publish", "dispatch", "device", "result"):
+                    assert want in wire_stages, (want, wire_stages)
+                # engine metrics through the same registry
+                assert any(
+                    value >= 1 for _, value in
+                    parsed["dpow_engine_batch_occupancy_count"]
+                )
+                assert any(
+                    labels.get("engine") == "jax" and value >= 1
+                    for labels, value in parsed["dpow_engine_device_seconds_count"]
+                )
+                assert any(
+                    labels.get("engine") == "jax" and value >= 1
+                    for labels, value in parsed["dpow_engine_solutions_total"]
+                )
+                # machine-readable twin of the same surface
+                snap = obs.snapshot()
+                assert snap["dpow_server_requests_total"]["series"]["ondemand"] >= 1
+        finally:
+            await stop_stack(runner, clients)
+
+    run(main())
+
+
 def test_e2e_late_worker_heals_stranded_request():
     """The republish heal at full-stack level: a request POSTs while ZERO
     workers are connected (its QoS-0 work publish fires into the void), a

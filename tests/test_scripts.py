@@ -256,6 +256,68 @@ def test_latency_probe_over_mqtt_wire():
     assert probe.summary()["cancels"] == 1
 
 
+def test_check_latency_from_metrics_summarizes_histograms():
+    """--from-metrics reads the product's own telemetry: the summary over a
+    rendered page must report request counts and stage p50s estimated from
+    the histogram buckets."""
+    from tpu_dpow import obs
+    from tpu_dpow.obs.registry import Registry
+
+    reg = Registry()
+    req = reg.counter("dpow_server_requests_total", "", ("work_type",))
+    req.inc(3, "ondemand")
+    lat = reg.histogram("dpow_server_request_seconds", "", ("work_type",))
+    for v in (0.010, 0.020, 0.030):
+        lat.observe(v, "ondemand")
+    stage = reg.histogram("dpow_request_stage_seconds", "", ("stage",))
+    for s in ("queue", "publish", "device"):
+        stage.observe(0.004, s)
+    summary = cl.summarize_metrics(obs.render(reg))
+    assert summary["requests_total"] == {"ondemand": 3}
+    ond = summary["request_latency"]["ondemand"]
+    assert ond["count"] == 3
+    # p50 of three obs in the (15.6, 31.2] ms log2 bucket: inside that band
+    assert 10 <= ond["p50_ms"] <= 32
+    assert set(summary["stage_p50_ms"]) == {"queue", "publish", "device"}
+    assert all(1 <= v <= 8 for v in summary["stage_p50_ms"].values())
+
+
+def test_check_latency_from_metrics_end_to_end_http():
+    """The flag scrapes a live /metrics endpoint over HTTP."""
+    from aiohttp import web
+
+    from tpu_dpow import obs
+    from tpu_dpow.obs.registry import Registry
+
+    async def flow(capsys_out):
+        reg = Registry()
+        reg.counter("dpow_server_requests_total", "", ("work_type",)).inc(
+            1, "precache")
+        app = web.Application()
+        obs.add_metrics_route(app, reg)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            rc = await cl.amain(
+                ["--from-metrics", f"http://127.0.0.1:{port}/metrics"])
+            assert rc == 0
+        finally:
+            await runner.cleanup()
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        run(flow(buf))
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["source"] == "metrics"
+    assert out["requests_total"] == {"precache": 1}
+
+
 def test_services_cli_on_sqlite_store(tmp_path):
     """The admin CLI operates on the server's live sqlite database — the
     reference's equivalent is redis-cli access to the shared Redis."""

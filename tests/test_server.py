@@ -18,6 +18,7 @@ from tpu_dpow.server.app import WORK_PENDING
 from tpu_dpow.store import MemoryStore
 from tpu_dpow.transport.broker import Broker
 from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.transport.mqtt_codec import parse_work_payload
 from tpu_dpow.utils import nanocrypto as nc
 
 RNG = np.random.default_rng(11)
@@ -94,13 +95,21 @@ class Harness:
             async for msg in t.messages():
                 self.worker_log.append(msg)
                 if msg.topic.startswith("work/") and respond:
-                    bh, diff_hex = msg.payload.split(",")
+                    # The shared payload grammar: work carries an optional
+                    # trailing trace id now (transport/mqtt_codec.py).
+                    bh, diff_hex, _tid = parse_work_payload(msg.payload)
                     work = solve(bh, int(diff_hex, 16))
                     work_type = msg.topic.split("/", 1)[1]
                     await t.publish(f"result/{work_type}", f"{bh},{work},{account}")
 
         self.worker_task = asyncio.ensure_future(loop())
         return t
+
+
+def wire(payload: str) -> str:
+    """The hash,difficulty part of a work payload (trace id stripped)."""
+    bh, diff_hex, _tid = parse_work_payload(payload)
+    return f"{bh},{diff_hex}"
 
 
 def run(coro):
@@ -577,7 +586,7 @@ def test_concurrent_base_and_raised_dispatch_single_future():
             assert a == b
             await asyncio.sleep(0.05)
             work_msgs = [m for m in hx.worker_log if m.topic.startswith("work/")]
-            assert [m.payload for m in work_msgs] == [
+            assert [wire(m.payload) for m in work_msgs] == [
                 f"{h},{EASY_BASE:016x}",  # base dispatch
                 f"{h},{raised:016x}",     # the raised waiter's re-target
             ]
@@ -623,7 +632,7 @@ def test_raised_request_retargets_inflight_dispatch():
             await wait_until(
                 lambda: sum(m.topic == "work/ondemand" for m in hx.worker_log) >= 2
             )
-            payloads = [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
+            payloads = [wire(m.payload) for m in hx.worker_log if m.topic == "work/ondemand"]
             assert payloads == [f"{h},{EASY_BASE:016x}", f"{h},{raised:016x}"]
             assert await hx.store.get(f"block-difficulty:{h}") == f"{raised:016x}"
 
@@ -681,7 +690,7 @@ def test_raise_landing_mid_dispatch_is_not_clobbered():
             await wait_until(
                 lambda: any(
                     m.topic == "work/ondemand"
-                    and m.payload == f"{h},{raised:016x}"
+                    and wire(m.payload) == f"{h},{raised:016x}"
                     for m in hx.worker_log
                 )
             )
@@ -697,7 +706,7 @@ def test_raise_landing_mid_dispatch_is_not_clobbered():
             # worker on work the result handler no longer accepts if the
             # raiser's QOS_0 publish were the one that got lost.
             assert all(
-                m.payload == f"{h},{raised:016x}"
+                wire(m.payload) == f"{h},{raised:016x}"
                 for m in hx.worker_log
                 if m.topic == "work/ondemand"
             ), [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
@@ -768,7 +777,7 @@ def test_republish_carries_raised_target():
             )
             # every re-announcement the late worker sees carries the raise
             republished = [
-                m.payload for m in hx.worker_log if m.topic == "work/ondemand"
+                wire(m.payload) for m in hx.worker_log if m.topic == "work/ondemand"
             ]
             assert republished and all(
                 p == f"{h},{raised:016x}" for p in republished
@@ -830,7 +839,7 @@ def test_raised_request_noop_when_inflight_already_stronger():
                 hx.server.service_handler(hx.request(h, timeout=10))
             )
             await asyncio.sleep(0.1)
-            payloads = [m.payload for m in hx.worker_log if m.topic == "work/ondemand"]
+            payloads = [wire(m.payload) for m in hx.worker_log if m.topic == "work/ondemand"]
             assert payloads == [f"{h},{raised:016x}"]  # no second publish
             assert await hx.store.get(f"block-difficulty:{h}") == f"{raised:016x}"
 

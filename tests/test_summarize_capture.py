@@ -210,24 +210,29 @@ def test_repo_invalidation_list_covers_the_r4_mesh1_record():
     assert sc.invalidation_reason("latency_mesh1", rec, entries) is not None
 
 
-def test_unreadable_invalidation_list_warns_loudly(tmp_path):
-    # Fail-open is tolerable only if it is LOUD: a truncated list must not
-    # silently re-enable PASS for disavowed records.
+def test_unreadable_invalidation_list_fails_closed(tmp_path):
+    # An unreadable (truncated / merge-conflicted) disavowal list must
+    # FAIL CLOSED (ADVICE r5): no record can prove it is not disavowed, so
+    # every step grades stale — never PASS — and the exit code is nonzero
+    # even though nothing graded FAIL.
     inv = tmp_path / "invalidated.json"
     inv.write_text('[{"step": "x",')  # merge-conflict / truncation artifact
     rec = {"rc": 0, "mark": "r4", "result": {"p50_ms": 183.6}}
     proc, rows = summarize(tmp_path, {"latency_mesh1": rec},
                            ["--mark", "r4", "--invalidated", str(inv)])
-    assert "WARNING" in proc.stdout and "unreadable" in proc.stdout
-    assert rows["latency_mesh1"][0] == "PASS"  # open, but announced
+    assert "unreadable" in proc.stdout
+    assert rows["latency_mesh1"][0] == "stale"
+    assert proc.returncode != 0
     # An entry with no match fingerprint can never fire: warn, don't ignore
     # silently (match-all would break re-capture supersession by design).
+    # Entry-level damage stays fail-open — the rest of the list still works.
     inv.write_text(json.dumps([{"step": "latency_mesh1", "mark": "r4",
                                 "reason": "no fingerprint"}]))
     proc, rows = summarize(tmp_path, {"latency_mesh1": rec},
                            ["--mark", "r4", "--invalidated", str(inv)])
     assert "WARNING" in proc.stdout and "fingerprint" in proc.stdout
     assert rows["latency_mesh1"][0] == "PASS"
+    assert proc.returncode == 0
 
 
 def test_crashed_criteria_step_grades_fail_not_absent(tmp_path):

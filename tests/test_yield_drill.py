@@ -110,3 +110,34 @@ def test_drill_yields_real_holder_to_announced_driver(tmp_path, monkeypatch):
     assert r["ok"] is True, r
     # And a second invocation self-skips on the fresh ok record.
     assert yield_drill.fresh_ok(str(out), "test")
+
+
+def test_drill_refuses_while_capture_holds_artifact_lock(tmp_path, monkeypatch):
+    """ADVICE r5: a manually launched drill must not race a mid-flight
+    capture's read-modify-write of the shared artifact — with the capture's
+    lock held, the drill exits rc 3 (try again later) without writing."""
+    out = tmp_path / "bench.json"
+    monkeypatch.setattr(
+        sys, "argv", ["yield_drill.py", "--mark", "t", "--out", str(out)])
+    with yield_drill.ce.artifact_lock(str(out)):  # a "capture" mid-flight
+        assert yield_drill.main() == 3
+    assert not out.exists()
+
+
+def test_concurrent_captures_on_one_artifact_refused(tmp_path):
+    """Second capture on the SAME artifact is refused (rc 2) while the
+    first holds the lock; a different artifact is unaffected."""
+    import subprocess
+
+    ce = yield_drill.ce
+    env = dict(os.environ)
+    env["TPU_DPOW_BENCH_OUT"] = str(tmp_path / "bench.json")
+    env["PYTHONPATH"] = REPO
+    with ce.artifact_lock(str(tmp_path / "bench.json")):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "capture_evidence.py"),
+             "--steps", "headline"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert proc.returncode == 2, (proc.stdout, proc.stderr)
+        assert "busy" in proc.stderr

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models import WorkRequest
 from ..ops import pallas_kernel, search
 from ..utils import nanocrypto as nc
@@ -304,10 +305,45 @@ class JaxWorkBackend(WorkBackend):
         # Per-stage latency decomposition (benchmarks/overhead.py): when on,
         # every launch appends {t_dispatch, t_thread, t_done, t_apply,
         # batch, steps} and every solve appends {queue_wait, total} to
-        # ``timeline``. Off by default — stamps cost a few perf_counter()
-        # calls per launch, nothing on the device path.
+        # ``timeline``. The perf_counter stamps themselves are ALWAYS taken
+        # (a few ns each, nothing on the device path) because the metrics
+        # below consume them; record_timeline only gates the deque.
         self.record_timeline = False
         self.timeline: "deque[tuple]" = deque(maxlen=1024)
+        # Registry metrics (tpu_dpow.obs): batch occupancy, executor-queue
+        # vs device time (from the launch stamps), chunk rate in H/s —
+        # the numbers ISSUE/VERDICT rounds had to reconstruct from logs.
+        reg = obs.get_registry()
+        self._tracer = obs.get_tracer()
+        self._m_hashes = reg.counter(
+            "dpow_engine_hashes_total", "Nonces scanned on device", ("engine",))
+        self._m_solutions = reg.counter(
+            "dpow_engine_solutions_total", "Nonces found and host-validated",
+            ("engine",))
+        self._m_batch_rows = reg.histogram(
+            "dpow_engine_batch_occupancy",
+            "Live jobs packed per device launch (padding excluded)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+        self._m_exec_queue = reg.histogram(
+            "dpow_engine_executor_queue_seconds",
+            "Launch wait between executor submit and the launch thread "
+            "starting", ("engine",))
+        self._m_device_seconds = reg.histogram(
+            "dpow_engine_device_seconds",
+            "Blocking device launch time (dispatch + scan + readback)",
+            ("engine",))
+        self._m_queue_wait = reg.histogram(
+            "dpow_engine_queue_wait_seconds",
+            "Job wait from submission to its first device dispatch",
+            ("engine",))
+        self._m_jobs = reg.gauge(
+            "dpow_engine_jobs", "Jobs currently tracked by the engine",
+            ("engine",))
+        self._m_rungs = reg.gauge(
+            "dpow_engine_rungs", "Distinct difficulty rungs with live demand")
+        self._m_hash_rate = reg.gauge(
+            "dpow_engine_hash_rate_hs",
+            "Scan rate of the most recently applied launch (H/s)", ("engine",))
 
     # -- WorkBackend interface -------------------------------------------
 
@@ -359,7 +395,7 @@ class JaxWorkBackend(WorkBackend):
             params=search.pack_params(request.hash_bytes, request.difficulty, 0),
             future=asyncio.get_running_loop().create_future(),
             base=0,
-            t_submit=time.perf_counter() if self.record_timeline else 0.0,
+            t_submit=time.perf_counter(),
         )
         job.set_base(secrets.randbits(64))
         self._jobs[key] = job
@@ -786,21 +822,23 @@ class JaxWorkBackend(WorkBackend):
         params = self._pack(active, b)
         span = self.chunk * steps
         factors = [self._miss_factor(j.difficulty, span) for j in active]
-        timing = None
-        if self.record_timeline:
-            # Timeline stamps the PHYSICAL queue depth: the overhead
-            # decomposition buckets head-vs-successor device time by
-            # "nothing in front of it on the device", which a corpse launch
-            # still is — only the WIDTH policy treats corpses as absent.
-            timing = {
-                "t_dispatch": time.perf_counter(),
-                "inflight": (
-                    inflight if physical_inflight is None else physical_inflight
-                ),
-            }
-            for j in active:
-                if not j.t_first_dispatch:
-                    j.t_first_dispatch = timing["t_dispatch"]
+        # Timing stamps the PHYSICAL queue depth: the overhead
+        # decomposition buckets head-vs-successor device time by
+        # "nothing in front of it on the device", which a corpse launch
+        # still is — only the WIDTH policy treats corpses as absent.
+        timing = {
+            "t_dispatch": time.perf_counter(),
+            "inflight": (
+                inflight if physical_inflight is None else physical_inflight
+            ),
+        }
+        self._m_batch_rows.observe(len(active))
+        self._m_jobs.set(len(self._jobs), "jax")
+        self._m_rungs.set(len(rungs))
+        for j in active:
+            if not j.t_first_dispatch:
+                j.t_first_dispatch = timing["t_dispatch"]
+                self._tracer.mark_hash(j.block_hash, "pack")
         rec = _Launch(
             fut=self._submit_launch(params, steps, timing),
             jobs=active,
@@ -821,10 +859,20 @@ class JaxWorkBackend(WorkBackend):
 
     def _apply_results(self, rec: "_Launch", lo_arr, hi_arr) -> None:
         self._warm.add(rec.shape)  # organic warming
-        if rec.timing is not None:
-            rec.timing["t_apply"] = time.perf_counter()
-            rec.timing["batch"], rec.timing["steps"] = rec.shape
-            self.timeline.append(("launch", rec.timing))
+        timing = rec.timing
+        applied_hashes = 0
+        if timing is not None:
+            timing["t_apply"] = time.perf_counter()
+            timing["batch"], timing["steps"] = rec.shape
+            if "t_thread" in timing and "t_done" in timing:
+                self._m_exec_queue.observe(
+                    max(0.0, timing["t_thread"] - timing["t_dispatch"]), "jax"
+                )
+                self._m_device_seconds.observe(
+                    max(0.0, timing["t_done"] - timing["t_thread"]), "jax"
+                )
+            if self.record_timeline:
+                self.timeline.append(("launch", timing))
         for job, f in zip(rec.jobs, rec.miss_factors):
             # This launch is no longer in flight: undo its coverage factor
             # (clamped — repeated multiply/divide may drift past 1.0).
@@ -837,19 +885,27 @@ class JaxWorkBackend(WorkBackend):
             nonce = (int(hi) << 32) | int(lo)
             if nonce == _MASK64:  # span exhausted without a hit
                 self.total_hashes += rec.span
+                applied_hashes += rec.span
                 # base already advanced at dispatch — exactly the miss case
                 # the speculation assumed.
                 continue
             scanned = ((nonce - base) & _MASK64) + 1
             self.total_hashes += scanned
+            applied_hashes += scanned
             if job.future.done():
                 continue  # cancelled/solved while the launch was in flight: drop
             work = search.work_hex_from_nonce(nonce)
             value = nc.work_value(job.block_hash, work)
             if value >= job.difficulty:
                 self.total_solutions += 1
+                self._m_solutions.inc(1, "jax")
+                self._tracer.mark_hash(job.block_hash, "device")
+                if job.t_submit:
+                    self._m_queue_wait.observe(
+                        max(0.0, job.t_first_dispatch - job.t_submit), "jax"
+                    )
                 job.future.set_result(work)
-                if rec.timing is not None and job.t_submit:
+                if self.record_timeline and job.t_submit:
                     now = time.perf_counter()
                     self.timeline.append((
                         "solve",
@@ -873,6 +929,13 @@ class JaxWorkBackend(WorkBackend):
                         f"{job.block_hash} (value {value:016x} < {launched:016x})"
                     )
                 )
+        self._m_hashes.inc(applied_hashes, "jax")
+        if timing is not None and timing.get("t_done", 0.0) > timing.get(
+            "t_thread", 0.0
+        ):
+            self._m_hash_rate.set(
+                applied_hashes / (timing["t_done"] - timing["t_thread"]), "jax"
+            )
 
     async def _engine_loop_inner(self) -> None:
         inflight: deque = deque()
@@ -968,3 +1031,8 @@ class JaxWorkBackend(WorkBackend):
     def _gc_jobs(self) -> None:
         for key in [k for k, j in self._jobs.items() if j.future.done()]:
             del self._jobs[key]
+        # A drained engine must read 0, not the last batch's values — the
+        # pack path only runs while there is demand to pack.
+        self._m_jobs.set(len(self._jobs), "jax")
+        if not self._jobs:
+            self._m_rungs.set(0)

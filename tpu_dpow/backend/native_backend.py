@@ -27,6 +27,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from .. import obs
 from ..models import WorkRequest
 from ..utils import nanocrypto as nc
 
@@ -212,6 +213,18 @@ class NativeWorkBackend(WorkBackend):
         self._closed = False
         self.total_hashes = 0
         self.total_solutions = 0
+        # Same engine-metric families as the jax backend, under its own
+        # engine label — one dashboard covers a mixed fleet.
+        reg = obs.get_registry()
+        self._tracer = obs.get_tracer()
+        self._m_hashes = reg.counter(
+            "dpow_engine_hashes_total", "Nonces scanned on device", ("engine",))
+        self._m_solutions = reg.counter(
+            "dpow_engine_solutions_total", "Nonces found and host-validated",
+            ("engine",))
+        self._m_jobs = reg.gauge(
+            "dpow_engine_jobs", "Jobs currently tracked by the engine",
+            ("engine",))
 
     async def setup(self) -> None:
         self._closed = False
@@ -274,6 +287,8 @@ class NativeWorkBackend(WorkBackend):
                 cancel_flag=ctypes.c_int32(0),
             )
             self._jobs[key] = job
+            self._m_jobs.set(len(self._jobs), "native")
+            self._tracer.mark_hash(key, "pack")
             # The scan is its own task, owned by no waiter: any one waiter
             # giving up must not tear down a job others still share. The job
             # keeps the strong reference (the event loop holds tasks weakly
@@ -300,6 +315,7 @@ class NativeWorkBackend(WorkBackend):
                     job.cancel_flag,
                 )
                 self.total_hashes += hashes
+                self._m_hashes.inc(hashes, "native")
                 if job.future.done():  # cancelled (or closed) while in flight
                     break
                 if not found:
@@ -314,6 +330,8 @@ class NativeWorkBackend(WorkBackend):
                 if value >= job.difficulty:
                     # Host hashlib re-check: belt to the native suspenders.
                     self.total_solutions += 1
+                    self._m_solutions.inc(1, "native")
+                    self._tracer.mark_hash(key, "device")
                     job.future.set_result(work)
                 elif value >= difficulty:
                     # Target raised mid-flight: keep scanning past this hit.
@@ -335,6 +353,7 @@ class NativeWorkBackend(WorkBackend):
         finally:
             if self._jobs.get(key) is job:
                 del self._jobs[key]
+            self._m_jobs.set(len(self._jobs), "native")
 
     async def cancel(self, block_hash: str) -> None:
         job = self._jobs.get(nc.validate_block_hash(block_hash))

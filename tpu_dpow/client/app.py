@@ -23,9 +23,11 @@ import time
 import traceback
 from typing import Optional
 
+from .. import obs
 from ..backend import WorkBackend, get_backend
 from ..models import WorkRequest, WorkType
 from ..transport import Message, QOS_0, QOS_1, Transport
+from ..transport.mqtt_codec import encode_result_payload, parse_work_payload
 from ..utils import nanocrypto as nc
 from ..utils.logging import get_logger
 from .config import ClientConfig
@@ -73,16 +75,31 @@ class DpowClient:
         self.last_heartbeat: Optional[float] = None
         self._server_online = True
         self._tasks: list = []
+        self._metrics_runner = None
+        self.metrics_port: Optional[int] = None  # bound port once serving
         self.stats = {"works_accepted": 0, "latest_stats": None}
+        reg = obs.get_registry()
+        self._tracer = obs.get_tracer()
+        self._m_work_received = reg.counter(
+            "dpow_client_work_received_total",
+            "Work messages received off the broker, by type", ("work_type",))
+        self._m_results_published = reg.counter(
+            "dpow_client_results_published_total",
+            "Solved results published to the broker", ("work_type",))
 
     # -- wiring ---------------------------------------------------------
 
     async def _send_result(self, request: WorkRequest, work: str) -> None:
         await self.transport.publish(
             f"result/{request.work_type.value}",
-            f"{request.block_hash},{work},{self.config.payout_address}",
+            encode_result_payload(
+                request.block_hash, work, self.config.payout_address,
+                self._tracer.id_for(request.block_hash),
+            ),
             qos=QOS_0,
         )
+        self._m_results_published.inc(1, request.work_type.value)
+        self._tracer.mark_hash(request.block_hash, "result")
 
     async def setup(self) -> None:
         await self.transport.connect()
@@ -106,6 +123,7 @@ class DpowClient:
                 f"client/{self.config.payout_address}", qos=QOS_1
             )
         await self.work_handler.start()
+        await self._start_metrics_app()
         # One startup line (reference client logs its connection status): a
         # healthy worker is otherwise silent until the first stats snapshot,
         # indistinguishable from one wedged in setup. Credentials stripped —
@@ -117,6 +135,25 @@ class DpowClient:
             ", ".join(f"work/{t}" for t in self.config.work_type.topics),
             self.config.backend,
         )
+
+    async def _start_metrics_app(self) -> None:
+        """Serve GET /metrics for this worker (config.metrics_port >= 0;
+        0 binds an ephemeral port, recorded in self.metrics_port). The
+        server scrapes its upcheck port; a worker fleet scrapes here —
+        engine batch occupancy, H/s, queue depth, per-stage spans."""
+        if self.config.metrics_port < 0 or self._metrics_runner is not None:
+            return
+        from aiohttp import web
+
+        app = web.Application()
+        obs.add_metrics_route(app)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, self.config.metrics_host, self.config.metrics_port)
+        await site.start()
+        self._metrics_runner = runner
+        self.metrics_port = site._server.sockets[0].getsockname()[1]
+        logger.info("metrics served on :%d/metrics", self.metrics_port)
 
     async def _await_first_heartbeat(self) -> None:
         async for msg in self.transport.messages():
@@ -139,7 +176,7 @@ class DpowClient:
 
     async def handle_work(self, work_type: str, payload: str) -> None:
         try:
-            block_hash, difficulty_hex = payload.split(",")
+            block_hash, difficulty_hex, trace_id = parse_work_payload(payload)
             request = WorkRequest(
                 block_hash=block_hash,
                 difficulty=int(difficulty_hex, 16),
@@ -148,6 +185,10 @@ class DpowClient:
         except (ValueError, nc.InvalidBlockHash, nc.InvalidDifficulty) as e:
             logger.warning("could not parse work message %r: %s", payload, e)
             return
+        self._m_work_received.inc(1, work_type)
+        if trace_id is not None:
+            self._tracer.alias(request.block_hash, trace_id)
+        self._tracer.mark_hash(request.block_hash, "dispatch")
         await self.work_handler.queue_work(request)
 
     def handle_stats(self, payload: str) -> None:
@@ -266,6 +307,10 @@ class DpowClient:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        if self._metrics_runner is not None:
+            await self._metrics_runner.cleanup()
+            self._metrics_runner = None
+            self.metrics_port = None
         if self.work_handler._started:
             await self.work_handler.stop()
         await self.transport.close()

@@ -27,6 +27,10 @@ class ClientConfig:
     step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
     shared_steps_cap: int = 0  # 0 = auto (run_steps/4); windows/launch under contention
     work_concurrency: int = 0  # 0 = auto: 2*max_batch (jax) / 8 (others)
+    # Prometheus /metrics for this worker: -1 = off, 0 = ephemeral port
+    # (DpowClient.metrics_port reports the binding), >0 = fixed port.
+    metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
     client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
     log_file: Optional[str] = None
     # Persistent XLA compilation cache dir ("" = off). A restarted worker
@@ -88,6 +92,14 @@ def parse_args(argv=None) -> ClientConfig:
     p.add_argument("--work_concurrency", type=int, default=c.work_concurrency,
                    help="work items in flight at once (0 = auto: 2*max_batch "
                    "for the jax backend, 8 otherwise)")
+    p.add_argument("--metrics_port", type=int, default=c.metrics_port,
+                   help="serve Prometheus GET /metrics on this port "
+                   "(-1 = off, 0 = ephemeral; engine occupancy, H/s, "
+                   "queue depth, per-stage request spans)")
+    p.add_argument("--metrics_host", default=c.metrics_host,
+                   help="bind address for --metrics_port (default loopback; "
+                   "set 0.0.0.0 only behind a firewall — the page exposes "
+                   "operational internals)")
     p.add_argument("--client_id", default=c.client_id,
                    help="broker session id; must be unique per worker process "
                    "(default payout+hostname — set explicitly when running "

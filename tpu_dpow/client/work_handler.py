@@ -26,6 +26,7 @@ import random
 import traceback
 from typing import Awaitable, Callable, Dict, Optional, Set
 
+from .. import obs
 from ..backend import WorkBackend, WorkCancelled, WorkError
 from ..models import WorkRequest
 from ..utils.logging import get_logger
@@ -119,6 +120,23 @@ class WorkHandler:
         self._workers: list = []
         self._started = False
         self.stats = {"queued": 0, "deduped": 0, "solved": 0, "cancelled": 0, "errors": 0}
+        # Registry mirrors of the stats dict plus the two depth gauges the
+        # dict cannot express (current queue/ongoing, not lifetime counts).
+        reg = obs.get_registry()
+        self._m_events = reg.counter(
+            "dpow_client_work_total",
+            "Work-handler lifecycle events (queued/deduped/solved/"
+            "cancelled/errors)", ("event",))
+        self._m_queue_depth = reg.gauge(
+            "dpow_client_queue_depth", "Work items waiting for a worker slot")
+        self._m_ongoing = reg.gauge(
+            "dpow_client_ongoing", "Work items currently in the engine")
+
+    def _bump(self, event: str) -> None:
+        self.stats[event] += 1
+        self._m_events.inc(1, event)
+        self._m_queue_depth.set(len(self.queue))
+        self._m_ongoing.set(len(self.ongoing))
 
     async def start(self) -> None:
         # Startup probe: a broken engine must fail loudly before the client
@@ -163,32 +181,32 @@ class WorkHandler:
                 else:
                     await self.queue_cancel(bh)
                     self.queue.put(request)
-                    self.stats["queued"] += 1
+                    self._bump("queued")
                     return
-            self.stats["deduped"] += 1
+            self._bump("deduped")
             return
         queued = self.queue.get(bh)
         if queued is not None:
             if request.difficulty > queued.difficulty:
                 self.queue.replace(request)
                 logger.debug("raised queued difficulty for %s", bh)
-            self.stats["deduped"] += 1
+            self._bump("deduped")
             return
         self.queue.put(request)
-        self.stats["queued"] += 1
+        self._bump("queued")
 
     async def queue_cancel(self, block_hash: str) -> None:
         """Cancel queued or ongoing work for a hash (reference :61-80)."""
         if self.queue.remove(block_hash):
             logger.debug("removed queued work %s", block_hash)
-            self.stats["cancelled"] += 1
+            self._bump("cancelled")
             return
         if block_hash in self.ongoing:
             # Drop from ongoing FIRST: if the backend solves it in the same
             # instant, the completion sees it missing and discards
             # (reference :71-74, 109-114).
             self.ongoing.pop(block_hash, None)
-            self.stats["cancelled"] += 1
+            self._bump("cancelled")
             try:
                 await self.backend.cancel(block_hash)
             except Exception as e:
@@ -214,14 +232,14 @@ class WorkHandler:
                 continue
             except WorkError as e:
                 self._drop_own(bh, job)
-                self.stats["errors"] += 1
+                self._bump("errors")
                 logger.error("work generation failed for %s: %s", bh, e)
                 continue
             except asyncio.CancelledError:
                 raise
             except Exception:
                 self._drop_own(bh, job)
-                self.stats["errors"] += 1
+                self._bump("errors")
                 logger.error("unexpected backend failure:\n%s", traceback.format_exc())
                 continue
             # Completion/cancel race: only report if OUR job is still the
@@ -233,7 +251,7 @@ class WorkHandler:
                 logger.debug("work %s completed after cancel; dropped", bh)
                 continue
             del self.ongoing[bh]
-            self.stats["solved"] += 1
+            self._bump("solved")
             try:
                 await self.result_callback(job.request, work)
             except Exception:

@@ -5,6 +5,13 @@ observer and times, per block hash, the deltas work→first-result and
 work→cancel — the live round-trip health of the swarm (reference
 check_latency.py:18-39). Works against any Transport; the default connects
 to a TCP broker as the dashboard user.
+
+``--from-metrics [URL]`` skips the probe entirely and reads the product's
+own telemetry instead: it scrapes the Prometheus ``/metrics`` surface
+(server upcheck port by default) and summarizes the request-latency and
+per-stage span histograms the stack itself populated — the passive probe
+measures only what happens to fly by while it watches, the metrics mode
+reads everything the server served since it started.
 """
 
 from __future__ import annotations
@@ -106,10 +113,72 @@ class LatencyProbe:
         }
 
 
+def summarize_metrics(text: str) -> dict:
+    """Summary of a scraped /metrics page: request counts + latency
+    quantiles per work type, and the per-stage span p50s. Pure function so
+    tests can feed it a rendered page without a socket."""
+    from ..obs import histogram_quantile, parse_text
+
+    samples = parse_text(text)
+
+    def buckets_by_label(metric: str, label: str) -> dict:
+        out = {}
+        for labels, value in samples.get(f"{metric}_bucket", ()):
+            key = labels.get(label, "")
+            out.setdefault(key, []).append((float(labels["le"]), value))
+        return out
+
+    def q_ms(rows, q):
+        v = histogram_quantile(rows, q)
+        return round(v * 1000, 2) if v is not None else None
+
+    requests = {
+        labels.get("work_type", ""): value
+        for labels, value in samples.get("dpow_server_requests_total", ())
+    }
+    latency = {}
+    for work_type, rows in buckets_by_label(
+        "dpow_server_request_seconds", "work_type"
+    ).items():
+        count = int(max(c for _, c in rows)) if rows else 0
+        latency[work_type] = {
+            "count": count,
+            "p50_ms": q_ms(rows, 0.50),
+            "p90_ms": q_ms(rows, 0.90),
+        }
+    stages = {
+        stage: q_ms(rows, 0.50)
+        for stage, rows in buckets_by_label(
+            "dpow_request_stage_seconds", "stage"
+        ).items()
+    }
+    return {
+        "source": "metrics",
+        "requests_total": requests,
+        "request_latency": latency,
+        "stage_p50_ms": stages,
+    }
+
+
+async def scrape_metrics(url: str) -> dict:
+    import aiohttp
+
+    async with aiohttp.ClientSession() as http:
+        async with http.get(url, timeout=aiohttp.ClientTimeout(total=10)) as resp:
+            resp.raise_for_status()
+            return summarize_metrics(await resp.text())
+
+
 async def amain(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1883)
+    p.add_argument("--from-metrics", dest="from_metrics", nargs="?",
+                   const="http://127.0.0.1:5031/metrics", default=None,
+                   metavar="URL",
+                   help="summarize the stack's own /metrics endpoint "
+                   "(default URL: the server upcheck port) instead of "
+                   "timing a live probe")
     p.add_argument("--username", default="dpowinterface")
     p.add_argument("--password", default="dpowinterface")
     p.add_argument("--uri", default=None,
@@ -119,6 +188,9 @@ async def amain(argv=None) -> int:
     p.add_argument("--duration", type=float, default=None, help="seconds; default forever")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
+    if args.from_metrics:
+        print(json.dumps(await scrape_metrics(args.from_metrics)))
+        return 0
     if args.uri:
         from urllib.parse import quote, urlparse, urlunparse
 

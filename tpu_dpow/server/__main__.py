@@ -18,7 +18,11 @@ from ..utils.logging import get_logger
 from .api import ServerRunner
 from .app import DpowServer
 from .config import parse_args
-from .nano_ws import NanoWebsocketClient
+
+# NanoWebsocketClient is imported lazily where the node feed is actually
+# configured: it needs the optional ``websockets`` package, and a server
+# without --node_ws_uri (HTTP-callback precache, or precache off) must not
+# die at import time on a box that doesn't ship it.
 
 
 async def amain(argv=None) -> None:
@@ -52,6 +56,8 @@ async def amain(argv=None) -> None:
 
     node_client = None
     if config.enable_precache and config.node_ws_uri:
+        from .nano_ws import NanoWebsocketClient
+
         node_client = NanoWebsocketClient(config.node_ws_uri, server.block_arrival_ws_handler)
         node_client.start()
 

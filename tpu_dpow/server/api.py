@@ -19,12 +19,19 @@ from typing import Optional
 
 from aiohttp import WSMsgType, web
 
+from .. import obs
 from ..utils.logging import get_logger
 from .app import DpowServer
 from .config import ServerConfig
 from .exceptions import InvalidRequest, RequestTimeout, RetryRequest
 
 logger = get_logger("tpu_dpow.server")
+
+
+def _responses_counter():
+    return obs.get_registry().counter(
+        "dpow_server_responses_total",
+        "Service API responses, by outcome", ("outcome",))
 
 
 async def _handle_service_request(server: DpowServer, data) -> dict:
@@ -34,17 +41,22 @@ async def _handle_service_request(server: DpowServer, data) -> dict:
             raise InvalidRequest("Bad request (not json)")
         request_id = data.get("id")
         response = await server.service_handler(data)
+        _responses_counter().inc(1, "ok")
     except InvalidRequest as e:
         response = {"error": e.reason}
+        _responses_counter().inc(1, "invalid")
     except RequestTimeout:
         response = {"error": "Timeout reached without work", "timeout": True}
+        _responses_counter().inc(1, "timeout")
     except RetryRequest:
         response = {"error": "Retry request"}
+        _responses_counter().inc(1, "retry")
     except Exception:
         response = {
             "error": "Unknown error, please report the following timestamp "
             f"to the maintainers: {datetime.datetime.now()}"
         }
+        _responses_counter().inc(1, "internal_error")
         logger.critical(traceback.format_exc())
     if request_id is not None:
         response["id"] = request_id
@@ -127,6 +139,10 @@ def build_apps(server: DpowServer, broker=None):
     upcheck_app.router.add_get("/upcheck/blocks", upcheck_blocks_handler)
     upcheck_app.router.add_get("/upcheck/broker/", upcheck_broker_handler)
     upcheck_app.router.add_get("/upcheck/broker", upcheck_broker_handler)
+    # Prometheus scrape surface, on the port that is already the internal
+    # health face (never the public service port): request/result/dispatch
+    # counters, per-stage span histograms, engine + broker internals.
+    obs.add_metrics_route(upcheck_app)
 
     blocks_app = web.Application()
     blocks_app.router.add_post("/block/", block_cb_handler)

@@ -29,9 +29,11 @@ import time
 import traceback
 from typing import Dict, Optional
 
+from .. import obs
 from ..models import DifficultyModel, WorkType
 from ..store import MemoryStore, Store
 from ..transport import Message, QOS_0, QOS_1, Transport
+from ..transport.mqtt_codec import encode_work_payload, parse_result_payload
 from ..utils import nanocrypto as nc
 from ..utils.logging import get_logger
 from ..utils.throttle import Throttler
@@ -89,6 +91,35 @@ class DpowServer:
         self.work_republished = 0  # healed lost publishes (observability)
         self._tasks: list = []
         self._started = False
+        # Metrics (tpu_dpow.obs): the queue-depth / latency / outcome
+        # signals the reference's two Redis counters cannot answer. Family
+        # handles are get-or-create, so several servers in one process
+        # (tests) share series rather than clashing on registration.
+        reg = obs.get_registry()
+        self._tracer = obs.get_tracer()
+        self._m_requests = reg.counter(
+            "dpow_server_requests_total",
+            "Service requests served, by work type", ("work_type",))
+        self._m_request_seconds = reg.histogram(
+            "dpow_server_request_seconds",
+            "End-to-end service request latency, by work type", ("work_type",))
+        self._m_inflight = reg.gauge(
+            "dpow_server_inflight_requests",
+            "Service requests currently being handled")
+        self._m_dispatches = reg.gauge(
+            "dpow_server_inflight_dispatches",
+            "On-demand dispatches with an unresolved future")
+        self._m_results = reg.counter(
+            "dpow_server_results_total",
+            "Worker results received, by disposition", ("outcome",))
+        self._m_cancels = reg.counter(
+            "dpow_server_cancels_total", "Cancel fan-outs published")
+        self._m_precache = reg.counter(
+            "dpow_server_precache_dispatch_total",
+            "Precache work publishes triggered by block arrivals")
+        self._m_republished = reg.counter(
+            "dpow_server_work_republished_total",
+            "Lost work publishes healed by the republish loop")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -208,9 +239,14 @@ class DpowServer:
                 )
                 try:
                     await self.transport.publish(
-                        "work/ondemand", f"{block_hash},{difficulty:016x}", qos=QOS_0
+                        "work/ondemand",
+                        encode_work_payload(
+                            block_hash, difficulty, self._tracer.id_for(block_hash)
+                        ),
+                        qos=QOS_0,
                     )
                     self.work_republished += 1
+                    self._m_republished.inc()
                     logger.info("re-published pending work for %s", block_hash)
                 except Exception as e:
                     logger.warning("work re-publish failed: %s", e)
@@ -288,13 +324,14 @@ class DpowServer:
 
     async def client_result_handler(self, topic: str, content: str) -> None:
         try:
-            block_hash, work, client = content.split(",")
+            block_hash, work, client, trace_id = parse_result_payload(content)
         except ValueError:
             return
 
         # Work still wanted? (hash deleted once its frontier moved on)
         available = await self.store.get(f"block:{block_hash}")
         if not available or available != WORK_PENDING:
+            self._m_results.inc(1, "stale")
             return
 
         work_type = await self.store.get(f"work-type:{block_hash}") or WorkType.PRECACHE.value
@@ -304,6 +341,7 @@ class DpowServer:
         try:
             nc.validate_work(block_hash, work, difficulty)
         except (nc.InvalidWork, nc.InvalidBlockHash):
+            self._m_results.inc(1, "invalid")
             return
 
         # Winner election: exactly one result claims the lock
@@ -311,8 +349,18 @@ class DpowServer:
         if not await self.store.setnx(
             f"block-lock:{block_hash}", "1", expire=self.config.winner_lock_expiry
         ):
+            self._m_results.inc(1, "lost_election")
             return
 
+        self._m_results.inc(1, "winner")
+        if trace_id is not None:
+            # Bind the worker-echoed trace id so winner/cancel marks land
+            # even if this server never began the trace (restart
+            # mid-flight). Only the WINNING result may rebind: any earlier
+            # and a bogus/losing result carrying a forged id would hijack
+            # the live request's trace before validation rejected it.
+            self._tracer.alias(block_hash, trace_id)
+        self._tracer.mark_hash(block_hash, "winner")
         await self.store.set(f"block:{block_hash}", work, expire=self.config.block_expiry)
 
         future = self.work_futures.get(block_hash)
@@ -321,6 +369,8 @@ class DpowServer:
 
         # Tell everyone else to stop burning lanes on this hash.
         await self.transport.publish(f"cancel/{work_type}", block_hash, qos=QOS_1)
+        self._m_cancels.inc()
+        self._tracer.mark_hash(block_hash, "cancel")
 
         try:
             # Canonical spelling for ACCOUNTING (crediting the raw string
@@ -367,6 +417,10 @@ class DpowServer:
         if not should_precache or not self.config.enable_precache:
             return
 
+        # Precache traces start at the queue stage: there is no service
+        # accept, the block arrival IS the request.
+        trace_id = self._tracer.begin(block_hash, stage="queue")
+        self._m_precache.inc()
         aws = [
             self.store.set(f"account:{account}", block_hash, expire=self.config.account_expiry),
             self.store.set(f"block:{block_hash}", WORK_PENDING, expire=self.config.block_expiry),
@@ -375,7 +429,9 @@ class DpowServer:
             ),
             self.transport.publish(
                 "work/precache",
-                f"{block_hash},{self.config.base_difficulty:016x}",
+                encode_work_payload(
+                    block_hash, self.config.base_difficulty, trace_id
+                ),
                 qos=QOS_0,
             ),
         ]
@@ -403,6 +459,7 @@ class DpowServer:
                 )
             )
         await asyncio.gather(*aws)
+        self._tracer.mark(trace_id, "publish")
 
     async def block_arrival_ws_handler(self, data: dict) -> None:
         try:
@@ -433,6 +490,7 @@ class DpowServer:
         self._dispatched_difficulty.pop(block_hash, None)
         self._difficulty_locks.pop(block_hash, None)
         self._last_publish.pop(block_hash, None)
+        self._m_dispatches.set(len(self.work_futures))
 
     async def _authenticate(self, data: dict) -> str:
         service, api_key = str(data["user"]), str(data["api_key"])
@@ -474,6 +532,22 @@ class DpowServer:
         return float(timeout)
 
     async def service_handler(self, data: dict) -> dict:
+        """Metrics shell around the request logic: in-flight gauge up for
+        the duration, request-latency histogram observed on every exit path
+        (labeled by the work type actually served, or "unresolved" when the
+        request died before the precache/on-demand decision)."""
+        t0 = time.monotonic()
+        self._m_inflight.inc()
+        served = {"work_type": "unresolved"}
+        try:
+            return await self._service_request(data, served)
+        finally:
+            self._m_inflight.dec()
+            self._m_request_seconds.observe(
+                time.monotonic() - t0, served["work_type"]
+            )
+
+    async def _service_request(self, data: dict, served: dict) -> dict:
         if not {"hash", "user", "api_key"} <= data.keys():
             raise InvalidRequest(
                 "Incorrect submission. Required information: user, api_key, hash"
@@ -498,6 +572,7 @@ class DpowServer:
                     raise InvalidRequest("Invalid account")
             difficulty = self._resolve_difficulty(data)
             timeout = self._resolve_timeout(data)
+            self._tracer.begin(block_hash)  # stage: accept
 
             work = await self.store.get(f"block:{block_hash}")
             if work is None:
@@ -535,6 +610,8 @@ class DpowServer:
                     block_hash, account, difficulty, timeout
                 )
 
+            served["work_type"] = work_type
+            self._m_requests.inc(1, work_type)
             asyncio.ensure_future(self.store.hincrby(f"service:{service}", work_type))
 
             # Final validation: never hand a service bad work
@@ -570,6 +647,8 @@ class DpowServer:
             created = asyncio.get_running_loop().create_future()
             self.work_futures[block_hash] = created
             self._dispatched_difficulty[block_hash] = difficulty
+            self._m_dispatches.set(len(self.work_futures))
+            self._tracer.mark_hash(block_hash, "queue")
             try:
                 if account:
                     asyncio.ensure_future(
@@ -612,9 +691,14 @@ class DpowServer:
                     # otherwise grind at a target the result handler no
                     # longer accepts — with nothing left to re-publish.
                     await self.transport.publish(
-                        "work/ondemand", f"{block_hash},{effective:016x}", qos=QOS_0
+                        "work/ondemand",
+                        encode_work_payload(
+                            block_hash, effective, self._tracer.id_for(block_hash)
+                        ),
+                        qos=QOS_0,
                     )
                     self._last_publish[block_hash] = time.monotonic()
+                    self._tracer.mark_hash(block_hash, "publish")
             except BaseException:
                 # A failed dispatch must not leave a never-resolved future
                 # that later requests for this hash would silently wait on.
@@ -678,7 +762,11 @@ class DpowServer:
                             )
                             await self.transport.publish(
                                 "work/ondemand",
-                                f"{block_hash},{difficulty:016x}",
+                                encode_work_payload(
+                                    block_hash,
+                                    difficulty,
+                                    self._tracer.id_for(block_hash),
+                                ),
                                 qos=QOS_0,
                             )
                         except BaseException:

@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
+from .. import obs
 from . import AuthError, Message, QOS_1, TransportError, User, topic_matches
 
 MAX_QUEUE = 10_000
@@ -53,6 +54,27 @@ class Broker:
         self.users = users  # None → open broker (tests)
         self.sessions: Dict[str, Session] = {}
         self.stats = {"published": 0, "delivered": 0, "dropped": 0, "denied": 0}
+        # Registry mirror of the routing counters + the session inventory
+        # the /upcheck/broker JSON page exposes, now scrapeable.
+        reg = obs.get_registry()
+        self._m_messages = reg.counter(
+            "dpow_broker_messages_total",
+            "Broker routing events (published/delivered/dropped/denied)",
+            ("event",))
+        self._m_sessions = reg.gauge(
+            "dpow_broker_sessions", "Known sessions (durable ones included)")
+        self._m_connected = reg.gauge(
+            "dpow_broker_connected_sessions", "Sessions with a live connection")
+
+    def _count(self, event: str, n: int = 1) -> None:
+        self.stats[event] += n
+        self._m_messages.inc(n, event)
+
+    def _sync_session_gauges(self) -> None:
+        self._m_sessions.set(len(self.sessions))
+        self._m_connected.set(
+            sum(1 for s in self.sessions.values() if s.queue is not None)
+        )
 
     # -- connection lifecycle -----------------------------------------
 
@@ -112,6 +134,7 @@ class Broker:
         for msg in session.offline:
             self._enqueue(session, msg)
         session.offline.clear()
+        self._sync_session_gauges()
         return session
 
     def detach(self, session: Session, queue: Optional[asyncio.Queue] = None) -> None:
@@ -132,6 +155,7 @@ class Broker:
         # which must keep receiving messages.
         if session.clean and self.sessions.get(session.client_id) is session:
             self.sessions.pop(session.client_id, None)
+        self._sync_session_gauges()
 
     def _salvage(self, session: Session, queue: asyncio.Queue) -> None:
         """Move a dying queue's undelivered QoS-1 messages into the
@@ -147,7 +171,7 @@ class Broker:
             if msg.qos >= QOS_1 and not session.clean:
                 kept.append(msg)
             else:
-                self.stats["dropped"] += 1
+                self._count("dropped")
         if kept:
             self.requeue(session, kept)
 
@@ -170,14 +194,14 @@ class Broker:
                 self._enqueue(session, msg)
             return
         if session.clean:
-            self.stats["dropped"] += len(redeliveries)
+            self._count("dropped", len(redeliveries))
             return
         session.offline[:0] = redeliveries
         overflow = len(session.offline) - MAX_OFFLINE_QUEUE
         if overflow > 0:
             # Same shed policy as publish(): drop oldest first.
             del session.offline[:overflow]
-            self.stats["dropped"] += overflow
+            self._count("dropped", overflow)
 
     # -- pub/sub -------------------------------------------------------
 
@@ -196,7 +220,7 @@ class Broker:
 
     def subscribe(self, session: Session, pattern: str, qos: int) -> None:
         if not self.user_for(session).may_subscribe(pattern):
-            self.stats["denied"] += 1
+            self._count("denied")
             raise AuthError(f"{session.username!r} may not subscribe {pattern!r}")
         session.subscriptions[pattern] = qos
 
@@ -205,9 +229,9 @@ class Broker:
 
     def publish(self, session: Optional[Session], topic: str, payload: str, qos: int) -> None:
         if session is not None and not self.user_for(session).may_publish(topic):
-            self.stats["denied"] += 1
+            self._count("denied")
             raise AuthError(f"{session.username!r} may not publish to {topic!r}")
-        self.stats["published"] += 1
+        self._count("published")
         for target in list(self.sessions.values()):
             sub_qos = target.matches(topic)
             if sub_qos is None:
@@ -217,7 +241,7 @@ class Broker:
                 # subscription that slipped past (or predates) the
                 # subscribe-time check — or belongs to a user since removed
                 # from the ACL table — still never leaks messages.
-                self.stats["denied"] += 1
+                self._count("denied")
                 continue
             # Effective QoS = min(publish qos, subscription qos), per MQTT.
             eff = min(qos, sub_qos)
@@ -227,16 +251,16 @@ class Broker:
                     target.offline.append(msg)
                     if len(target.offline) > MAX_OFFLINE_QUEUE:
                         target.offline.pop(0)
-                        self.stats["dropped"] += 1
+                        self._count("dropped")
                 else:
-                    self.stats["dropped"] += 1
+                    self._count("dropped")
                 continue
             self._enqueue(target, msg)
 
     def _enqueue(self, target: Session, msg: Message) -> None:
         try:
             target.queue.put_nowait(msg)
-            self.stats["delivered"] += 1
+            self._count("delivered")
         except asyncio.QueueFull:
             # Shed load: drop the oldest queued message to admit the new one.
             try:
@@ -244,4 +268,4 @@ class Broker:
             except asyncio.QueueEmpty:
                 pass
             target.queue.put_nowait(msg)
-            self.stats["dropped"] += 1
+            self._count("dropped")

@@ -317,6 +317,59 @@ def decode(first_byte: int, body: bytes):
     raise MqttCodecError(f"unsupported packet type {ptype}")
 
 
+# -- dpow data-plane payload helpers ---------------------------------------
+#
+# The topic contract's comma-separated payloads (docs/specification.md:
+# work = "hash,difficulty", result = "hash,work,client") gain ONE optional
+# trailing field: a 16-hex trace id stamping the request through the
+# pipeline (tpu_dpow.obs.trace). Encoding/parsing lives here, next to the
+# wire format it extends, so every face (server, client, probes) agrees on
+# the grammar. Backward/forward compatible by construction: absent field =>
+# None; a peer that predates tracing parses the leading fields unchanged
+# and an unrecognized trailing token is ignored rather than rejected —
+# the MQTT packet encoding above is untouched (byte goldens hold).
+
+
+def _opt_trace(fields: List[str], at: int) -> Optional[str]:
+    from ..obs.trace import is_trace_id
+
+    if len(fields) > at and is_trace_id(fields[at]):
+        return fields[at]
+    return None
+
+
+def encode_work_payload(
+    block_hash: str, difficulty: int, trace_id: Optional[str] = None
+) -> str:
+    base = f"{block_hash},{difficulty:016x}"
+    return f"{base},{trace_id}" if trace_id else base
+
+
+def parse_work_payload(payload: str) -> Tuple[str, str, Optional[str]]:
+    """-> (block_hash, difficulty_hex, trace_id or None). Raises ValueError
+    on fewer than two fields (the pre-trace contract's minimum)."""
+    fields = payload.split(",")
+    if len(fields) < 2:
+        raise ValueError(f"work payload needs hash,difficulty: {payload!r}")
+    return fields[0], fields[1], _opt_trace(fields, 2)
+
+
+def encode_result_payload(
+    block_hash: str, work: str, client: str, trace_id: Optional[str] = None
+) -> str:
+    base = f"{block_hash},{work},{client}"
+    return f"{base},{trace_id}" if trace_id else base
+
+
+def parse_result_payload(payload: str) -> Tuple[str, str, str, Optional[str]]:
+    """-> (block_hash, work, client, trace_id or None). Raises ValueError
+    on fewer than three fields."""
+    fields = payload.split(",")
+    if len(fields) < 3:
+        raise ValueError(f"result payload needs hash,work,client: {payload!r}")
+    return fields[0], fields[1], fields[2], _opt_trace(fields, 3)
+
+
 async def read_packet(reader: asyncio.StreamReader, first_byte: Optional[bytes] = None):
     """One packet off an asyncio stream; returns None on clean EOF.
 
