@@ -1,0 +1,13 @@
+#!/usr/bin/env python3
+"""Runnable entry for the scripted chaos scenario — see
+tpu_dpow/scripts/chaos_demo.py for the scenario itself."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dpow.scripts.chaos_demo import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

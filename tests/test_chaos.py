@@ -1,0 +1,493 @@
+"""Chaos layer: the fault-injection wrappers themselves, then the two
+acceptance scenarios from the resilience tentpole —
+
+  1. the first work/ publish is dropped AND the first responding client is
+     killed mid-scan, and the request still completes via supervised
+     re-dispatch, inside the service deadline;
+  2. the jax engine fails three times, its circuit breaker opens, the
+     native fallback serves, and the breaker state is scrapeable on the
+     worker's /metrics page.
+
+Everything is deterministic: scripted FaultSchedules, seeded RNGs, and
+FakeClock for every grace window — no real-network flakiness, no real
+sleeps beyond event-loop settling.
+"""
+
+import asyncio
+import hashlib
+import struct
+
+import aiohttp
+import numpy as np
+import pytest
+
+from tpu_dpow import obs
+from tpu_dpow.backend import WorkBackend, WorkCancelled, WorkError
+from tpu_dpow.chaos import (
+    DELAY,
+    DISCONNECT,
+    DROP,
+    DUPLICATE,
+    ERROR,
+    HANG,
+    REORDER,
+    WRONG_WORK,
+    FakeClock,
+    FaultSchedule,
+    FaultyBackend,
+    FaultyStore,
+    FaultyTransport,
+    Rule,
+    invalid_work_for,
+)
+from tpu_dpow.client import ClientConfig, DpowClient
+from tpu_dpow.models import WorkRequest
+from tpu_dpow.resilience import OPEN, FailoverBackend
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import Message, TransportError
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+pytestmark = pytest.mark.chaos
+
+RNG = np.random.default_rng(7)
+EASY = 0xFF00000000000000  # ~256 hashes expected: instant everywhere
+PAYOUT_1 = nc.encode_account(bytes(range(32)))
+PAYOUT_2 = nc.encode_account(bytes(range(1, 33)))
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def solve(block_hash: str, difficulty: int) -> str:
+    h = bytes.fromhex(block_hash)
+    w = 0
+    while True:
+        v = int.from_bytes(
+            hashlib.blake2b(struct.pack("<Q", w) + h, digest_size=8).digest(),
+            "little",
+        )
+        if v >= difficulty:
+            return f"{w:016x}"
+        w += 1
+
+
+class BruteBackend(WorkBackend):
+    """Host-side brute force: instant at the EASY difficulties used here."""
+
+    async def setup(self):
+        pass
+
+    async def generate(self, request):
+        return solve(request.block_hash, request.difficulty)
+
+    async def cancel(self, block_hash):
+        pass
+
+
+async def settle(seconds=0.05):
+    """Real-time settle for event-loop handoffs (broker → client → engine);
+    all CHAOS timing still runs on the fake clock."""
+    await asyncio.sleep(seconds)
+
+
+# ----------------------------------------------------------- FaultSchedule
+
+
+def test_schedule_counts_after_and_fallthrough():
+    s = FaultSchedule([
+        Rule(op="publish", pattern="work/*", action=DROP, times=2, after=1),
+        Rule(op="publish", pattern="work/*", action=DELAY, times=1, delay=1.5),
+    ])
+    # match 1 is inside the first rule's pass-through prefix AND must not
+    # leak to the second rule's budget either... it falls through to DELAY.
+    first = s.decide("publish", "work/ondemand")
+    assert first is not None and first.action == DELAY
+    # matches 2-3: the DROP rule fires
+    assert s.decide("publish", "work/ondemand").action == DROP
+    assert s.decide("publish", "work/precache").action == DROP
+    # DROP exhausted, DELAY exhausted → clean
+    assert s.decide("publish", "work/ondemand") is None
+    # wrong op / pattern never match
+    assert s.decide("deliver", "work/ondemand") is None
+    assert s.decide("publish", "result/ondemand") is None
+    assert s.fired(DROP) == 2 and s.fired(DELAY) == 1
+
+
+def test_schedule_seeded_probability_is_reproducible():
+    def outcomes(seed):
+        s = FaultSchedule(
+            [Rule(op="get", action=ERROR, times=-1, prob=0.5)], seed=seed
+        )
+        return [s.decide("get", f"k{i}") is not None for i in range(64)]
+
+    a, b = outcomes(1234), outcomes(1234)
+    assert a == b  # same seed → identical fault sequence
+    assert any(a) and not all(a)  # it is actually probabilistic
+
+
+# --------------------------------------------------------- FaultyTransport
+
+
+def test_faulty_transport_publish_faults():
+    async def main():
+        broker = Broker()
+        sub = InProcTransport(broker, client_id="sub")
+        await sub.connect()
+        await sub.subscribe("t/#")
+        schedule = FaultSchedule([
+            Rule(op="publish", pattern="t/a", action=DROP, times=1),
+            Rule(op="publish", pattern="t/a", action=DUPLICATE, times=1),
+            Rule(op="publish", pattern="t/x", action=DISCONNECT, times=1),
+        ])
+        pub = FaultyTransport(InProcTransport(broker, client_id="pub"), schedule)
+        await pub.connect()
+        with pytest.raises(TransportError):
+            await pub.publish("t/x", "boom")
+        await pub.publish("t/a", "m1")  # dropped
+        await pub.publish("t/a", "m2")  # duplicated
+        await pub.publish("t/a", "m3")  # clean
+
+        got = []
+        async def drain():
+            async for m in sub.messages():
+                got.append(m.payload)
+                if len(got) == 3:
+                    return
+        await asyncio.wait_for(drain(), 5)
+        assert got == ["m2", "m2", "m3"]
+        await pub.close()
+        await sub.close()
+
+    run(main())
+
+
+def test_faulty_transport_deliver_drop_and_reorder():
+    async def main():
+        broker = Broker()
+        schedule = FaultSchedule([
+            Rule(op="deliver", pattern="t/*", action=DROP, times=1),
+            Rule(op="deliver", pattern="t/*", action=REORDER, times=1),
+        ])
+        sub = FaultyTransport(InProcTransport(broker, client_id="sub"), schedule)
+        await sub.connect()
+        await sub.subscribe("t/#")
+        pub = InProcTransport(broker, client_id="pub")
+        await pub.connect()
+        for p in ("m1", "m2", "m3", "m4"):
+            await pub.publish("t/a", p)
+        got = []
+        async def drain():
+            async for m in sub.messages():
+                got.append(m.payload)
+                if len(got) == 3:
+                    return
+        await asyncio.wait_for(drain(), 5)
+        # m1 dropped; m2 held past m3 (reorder); m4 clean
+        assert got == ["m3", "m2", "m4"]
+        await pub.close()
+        await sub.close()
+
+    run(main())
+
+
+# ----------------------------------------------------- FaultyStore/Backend
+
+
+def test_faulty_store_errors_and_passthrough():
+    async def main():
+        schedule = FaultSchedule([
+            Rule(op="set", pattern="block:*", action=ERROR, times=1),
+        ])
+        store = FaultyStore(MemoryStore(), schedule)
+        with pytest.raises(ConnectionError):
+            await store.set("block:AA", "0")
+        await store.set("block:AA", "0")  # rule exhausted → clean
+        assert await store.get("block:AA") == "0"
+        await store.hset("h", {"a": "1"})
+        assert await store.hgetall("h") == {"a": "1"}
+
+    run(main())
+
+
+def test_faulty_backend_error_wrong_work_and_hang_cancel():
+    async def main():
+        h = random_hash()
+        schedule = FaultSchedule([
+            Rule(op="generate", action=ERROR, times=1),
+            Rule(op="generate", action=WRONG_WORK, times=1),
+            Rule(op="generate", action=HANG, times=1),
+        ])
+        backend = FaultyBackend(BruteBackend(), schedule)
+        await backend.setup()
+        req = WorkRequest(h, EASY)
+        with pytest.raises(WorkError):
+            await backend.generate(req)
+        wrong = await backend.generate(req)
+        with pytest.raises(nc.InvalidWork):
+            nc.validate_work(h, wrong, EASY)
+        # hang: parks until cancel() releases it as WorkCancelled
+        hung = asyncio.ensure_future(backend.generate(req))
+        await settle()
+        assert not hung.done()
+        await backend.cancel(h)
+        with pytest.raises(WorkCancelled):
+            await hung
+        # schedule exhausted: the real engine serves
+        good = await backend.generate(req)
+        nc.validate_work(h, good, EASY)
+
+    run(main())
+
+
+def test_chaos_demo_scenario_completes():
+    """scripts/chaos_demo.py is the operator-facing walkthrough of the
+    whole resilience layer — keep it working."""
+    from tpu_dpow.scripts.chaos_demo import scenario
+
+    result = run(scenario())
+    assert result["primary_store_reconciled"]
+    assert any(e["op"] == "publish" and e["action"] == "drop"
+               for e in result["chaos_events"])
+    assert {e["action"] for e in result["chaos_events"]} == {"drop", "error"}
+    assert result["metrics"]["dpow_breaker_state"]["series"][
+        "backend:flaky"] == 1.0
+    assert result["metrics"]["dpow_server_work_republished_total"][
+        "series"][""] >= 1.0
+
+
+def test_invalid_work_for_never_validates():
+    h = random_hash()
+    # (a failing nonce gets rarer as difficulty drops — ~difficulty/2^64 of
+    # the space — so the helper is only meant for realistic targets)
+    for difficulty in (EASY, 0xFFFFFFC000000000, 0x8000000000000000):
+        wrong = invalid_work_for(h, difficulty)
+        with pytest.raises(nc.InvalidWork):
+            nc.validate_work(h, wrong, difficulty)
+
+
+# ------------------------------------------------- acceptance scenario 1
+
+
+def test_chaos_dropped_publish_and_killed_responder_heal_via_redispatch():
+    """ISSUE 2 acceptance: the first work/ publish evaporates (chaos drop),
+    the first client to pick up the re-dispatch dies mid-scan (hang + kill),
+    and the request STILL completes off the second, hedged re-dispatch —
+    all grace windows on a fake clock, inside the service deadline."""
+
+    async def main():
+        obs.reset()  # metric assertions below count THIS scenario only
+        clock = FakeClock()
+        broker = Broker()
+        server_faults = FaultSchedule([
+            Rule(op="publish", pattern="work/*", action=DROP, times=1),
+        ])
+        config = ServerConfig(
+            base_difficulty=EASY, throttle=1000.0, heartbeat_interval=0.05,
+            statistics_interval=3600.0, work_republish_interval=2.0,
+            hedge_after=2,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store,
+            FaultyTransport(
+                InProcTransport(broker, client_id="server"), server_faults,
+                clock=clock,
+            ),
+            clock=clock,
+        )
+        await server.setup()
+        server.start_loops()
+        await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                         "public": "N", "precache": "0",
+                                         "ondemand": "0"})
+        await store.sadd("services", "svc")
+
+        # client A: its engine hangs on its first (and only) job — the
+        # "first responding client", about to be killed mid-scan.
+        a_faults = FaultSchedule([Rule(op="generate", action=HANG, times=1)])
+        client_a = DpowClient(
+            ClientConfig(payout_address=PAYOUT_1, startup_heartbeat_wait=3.0),
+            InProcTransport(broker, client_id="worker-a"),
+            backend=FaultyBackend(BruteBackend(), a_faults),
+        )
+        # client B: healthy engine but PRECACHE-ONLY — it subscribes
+        # neither work/ondemand nor cancel/ondemand, so only the HEDGED
+        # re-dispatch (and its mirrored cancel) can reach it.
+        client_b = DpowClient(
+            ClientConfig(payout_address=PAYOUT_2, startup_heartbeat_wait=3.0,
+                         work_type="precache"),
+            InProcTransport(broker, client_id="worker-b"),
+            backend=BruteBackend(),
+        )
+        for c in (client_a, client_b):
+            await c.setup()
+            c.start_loops()
+
+        # passive observer: which cancel topics does the winner fan out to?
+        observer = InProcTransport(broker, client_id="observer")
+        await observer.connect()
+        await observer.subscribe("cancel/#", qos=1)
+        cancels = []
+
+        async def watch_cancels():
+            async for msg in observer.messages():
+                cancels.append(msg.topic)
+
+        watcher = asyncio.ensure_future(watch_cancels())
+
+        try:
+            h = random_hash()
+            request = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 20}
+            ))
+            await settle()  # the initial publish fires — into the chaos drop
+            assert server_faults.fired(DROP) == 1
+            assert not client_a.work_handler.ongoing
+            assert not client_b.work_handler.ongoing
+
+            # grace window elapses (fake time) → re-dispatch #1 (plain,
+            # work/ondemand only): A picks it up and hangs mid-scan; the
+            # precache-only B cannot hear it.
+            await clock.advance(2.0)
+            await settle()
+            assert server.work_republished == 1
+            assert h in client_a.work_handler.ongoing
+            assert not client_b.work_handler.ongoing
+
+            # kill the first responder mid-scan.
+            await client_a.close()
+
+            # next grace window → re-dispatch #2, HEDGED (work/ondemand AND
+            # work/precache): B is recruited from outside the hash's own
+            # pool and solves.
+            await clock.advance(2.0)
+            resp = await asyncio.wait_for(request, 10)
+            nc.validate_work(h, resp["work"], EASY)
+            assert server.work_republished >= 2
+
+            snap = obs.snapshot()
+            redispatch = snap["dpow_server_redispatch_total"]["series"]
+            assert redispatch.get("republish", 0) >= 1
+            assert redispatch.get("hedged", 0) >= 1
+            # B (and only B) was credited for the win — under the STORE's
+            # work type (ondemand), not the topic it was recruited from
+            await settle()
+            assert await store.hget(f"client:{PAYOUT_2}", "ondemand") == "1"
+            assert await store.hget(f"client:{PAYOUT_1}", "ondemand") is None
+            # and the winner's cancel mirrored the hedge: both pools told
+            # to stop, so recruited workers don't grind the resolved hash
+            assert "cancel/ondemand" in cancels
+            assert "cancel/precache" in cancels
+        finally:
+            watcher.cancel()
+            await asyncio.gather(watcher, return_exceptions=True)
+            await observer.close()
+            await client_b.close()
+            await server.close()
+
+    run(main())
+
+
+# ------------------------------------------------- acceptance scenario 2
+
+
+def test_chaos_jax_failures_open_breaker_native_serves_metrics_visible():
+    """ISSUE 2 acceptance: the jax engine throws WorkError three times →
+    its breaker opens; the native engine serves every request (including
+    while the breaker is open, without the jax engine even being tried);
+    breaker state and per-engine serving counts are scrapeable on the
+    worker's /metrics port."""
+
+    async def main():
+        from tpu_dpow.backend.jax_backend import JaxWorkBackend
+        from tpu_dpow.backend.native_backend import NativeWorkBackend
+
+        obs.reset()  # metric assertions below count THIS scenario only
+        broker = Broker()
+        config = ServerConfig(
+            base_difficulty=EASY, throttle=1000.0, heartbeat_interval=0.05,
+            statistics_interval=3600.0,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store, InProcTransport(broker, client_id="server")
+        )
+        await server.setup()
+        server.start_loops()
+        await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                         "public": "N", "precache": "0",
+                                         "ondemand": "0"})
+        await store.sadd("services", "svc")
+
+        # the REAL jax engine, wrapped so every generate raises WorkError
+        jax_faults = FaultSchedule([
+            Rule(op="generate", action=ERROR, times=-1),
+        ])
+        chain = FailoverBackend(
+            [
+                ("jax", FaultyBackend(
+                    JaxWorkBackend(kernel="xla", sublanes=8, iters=8),
+                    jax_faults,
+                )),
+                ("native", NativeWorkBackend()),
+            ],
+            failure_threshold=3, reset_timeout=3600.0,
+        )
+        client = DpowClient(
+            ClientConfig(payout_address=PAYOUT_1, startup_heartbeat_wait=3.0,
+                         metrics_port=0),
+            InProcTransport(broker, client_id="worker"),
+            backend=chain,
+        )
+        await client.setup()
+        client.start_loops()
+        try:
+            for i in range(5):
+                resp = await asyncio.wait_for(server.service_handler(
+                    {"user": "svc", "api_key": "secret",
+                     "hash": random_hash(), "timeout": 20}
+                ), 15)
+                nc.validate_work(resp["hash"], resp["work"], EASY)
+                if i == 2:
+                    assert chain.breakers["jax"].state == OPEN
+
+            # breaker OPEN: requests 4-5 never even reached the jax engine
+            # (the fault schedule saw exactly the three tripping calls)
+            assert chain.breakers["jax"].state == OPEN
+            assert jax_faults.fired(ERROR) == 3
+
+            # and the whole story is on the worker's /metrics page
+            async with aiohttp.ClientSession() as http:
+                url = f"http://127.0.0.1:{client.metrics_port}/metrics"
+                async with http.get(url) as resp:
+                    assert resp.status == 200
+                    page = await resp.text()
+            assert 'dpow_breaker_state{name="backend:jax"} 1' in page
+            families = obs.parse_text(page)
+
+            def value(metric, **labels):
+                for found, v in families.get(metric, []):
+                    if found == labels:
+                        return v
+                return 0.0
+
+            assert value("dpow_breaker_state", name="backend:jax") == 1.0
+            assert value("dpow_breaker_transitions_total",
+                         name="backend:jax", to="open") == 1.0
+            assert value("dpow_client_backend_served_total",
+                         backend="native") == 5.0
+            assert value("dpow_client_backend_failover_total",
+                         backend="jax", cause="error") == 3.0
+        finally:
+            await client.close()
+            await server.close()
+
+    run(main())

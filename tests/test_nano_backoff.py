@@ -1,0 +1,155 @@
+"""NanoWebsocketClient reconnect backoff, on FAKE websockets and a
+recording sleep — no real node, no real network, no real sleeps.
+
+(tests/test_nano_ws.py drives the same client against a real local
+websockets server; that file needs the ``websockets`` package, which this
+environment may not ship — the backoff schedule itself is asserted here
+through the injectable ``connect``/``sleep`` seams.)
+
+The schedule under test (server/nano_ws.py):
+  * exponential doubling from 1s, capped at ``reconnect_interval``;
+  * the delay resets ONLY once the feed is proven live (a confirmation
+    frame arrived) — a node that accepts, acks the subscribe, and closes
+    immediately must keep escalating, not pin the delay at its floor.
+"""
+
+import asyncio
+import json
+
+from tpu_dpow.server.nano_ws import NanoWebsocketClient
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=20))
+
+
+class FakeWs:
+    """One scripted connection: acks the subscribe, replays frames, closes.
+
+    Doubles as its own async context manager (what ``connect(uri)``
+    returns) and async iterator (what the read loop consumes).
+    """
+
+    def __init__(self, frames=(), ack=True):
+        self.frames = list(frames)
+        self.ack = ack
+        self.sent = []
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    async def send(self, data):
+        self.sent.append(data)
+
+    async def recv(self):
+        if not self.ack:
+            return json.dumps({"error": "nope"})
+        return json.dumps({"ack": "subscribe"})
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if not self.frames:
+            raise StopAsyncIteration  # clean server-side close
+        return self.frames.pop(0)
+
+
+def confirmation(block_hash="AB" * 32):
+    return json.dumps({
+        "topic": "confirmation",
+        "message": {"hash": block_hash, "account": "nano_x",
+                    "block": {"previous": None}},
+    })
+
+
+class BackoffHarness:
+    """Scripted connections + a sleep recorder that stops the client after
+    the script runs out (returning instantly: zero real delay)."""
+
+    def __init__(self, conns, stop_after_sleeps):
+        self.conns = list(conns)
+        self.sleeps = []
+        self.stop_after = stop_after_sleeps
+        self.seen = []
+        self.client = NanoWebsocketClient(
+            "ws://fake-node:7078", self._callback,
+            reconnect_interval=8.0, connect=self._connect, sleep=self._sleep,
+        )
+
+    def _connect(self, uri):
+        if not self.conns:
+            raise ConnectionRefusedError("script exhausted")
+        return self.conns.pop(0)
+
+    async def _callback(self, message):
+        self.seen.append(message)
+
+    async def _sleep(self, delay):
+        self.sleeps.append(delay)
+        if len(self.sleeps) >= self.stop_after:
+            self.client._stopped = True  # end the _run loop, no real wait
+
+
+def test_backoff_doubles_and_caps_without_a_live_frame():
+    """Accept + ack + instant close, forever: the delay must escalate
+    1, 2, 4, 8 and CAP at reconnect_interval — the ack alone must never
+    reset it (the regression the in-loop reset guards against)."""
+
+    async def main():
+        hx = BackoffHarness([FakeWs() for _ in range(6)], stop_after_sleeps=6)
+        await hx.client._run()
+        assert hx.sleeps == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+        assert hx.seen == []
+
+    run(main())
+
+
+def test_backoff_resets_only_after_proven_live_feed():
+    """Two dead accept/ack/close rounds escalate the delay; a connection
+    that actually DELIVERS a confirmation resets it to the floor — and the
+    frame reached the callback."""
+
+    async def main():
+        hx = BackoffHarness(
+            [FakeWs(), FakeWs(), FakeWs(frames=[confirmation()]), FakeWs()],
+            stop_after_sleeps=4,
+        )
+        await hx.client._run()
+        # dead, dead, live-then-closed, dead:
+        #   1 (after dead #1), 2 (after dead #2),
+        #   1 (reset: frame arrived), 2 (doubling resumes)
+        assert hx.sleeps == [1.0, 2.0, 1.0, 2.0]
+        assert len(hx.seen) == 1 and hx.seen[0]["hash"] == "AB" * 32
+        # the subscribe handshake went out on every connection attempt
+        assert hx.client._stopped
+
+    run(main())
+
+
+def test_backoff_connect_failures_escalate_too():
+    """A refused TCP connect (no ws object at all) rides the same
+    schedule as a dead accept/ack/close node."""
+
+    async def main():
+        hx = BackoffHarness([], stop_after_sleeps=5)
+        await hx.client._run()
+        assert hx.sleeps == [1.0, 2.0, 4.0, 8.0, 8.0]
+
+    run(main())
+
+
+def test_bad_subscribe_ack_is_a_connection_failure():
+    async def main():
+        hx = BackoffHarness(
+            [FakeWs(ack=False), FakeWs(frames=[confirmation()])],
+            stop_after_sleeps=2,
+        )
+        await hx.client._run()
+        assert hx.sleeps == [1.0, 1.0]  # bad ack escalates; live feed resets
+        assert len(hx.seen) == 1
+
+    run(main())

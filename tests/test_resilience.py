@@ -1,0 +1,734 @@
+"""Resilience layer units: FakeClock, CircuitBreaker, DispatchSupervisor,
+DegradedStore, FailoverBackend — plus the robustness satellites (O(1)
+WorkQueue, broker queue-full accounting, heartbeat watchdog metrics).
+
+Everything timer-driven runs on FakeClock: no real sleeps anywhere.
+"""
+
+import asyncio
+import logging
+
+import numpy as np
+import pytest
+
+from tpu_dpow import obs
+from tpu_dpow.backend import WorkBackend, WorkCancelled, WorkError
+from tpu_dpow.chaos import ERROR, FaultSchedule, FaultyStore, Rule
+from tpu_dpow.client import ClientConfig, DpowClient
+from tpu_dpow.client.work_handler import WorkQueue
+from tpu_dpow.models import WorkRequest
+from tpu_dpow.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    DegradedStore,
+    DispatchSupervisor,
+    FailoverBackend,
+    FakeClock,
+)
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import Message
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+
+RNG = np.random.default_rng(42)
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=20))
+
+
+# ------------------------------------------------------------- FakeClock
+
+
+def test_fake_clock_wakes_sleepers_in_order():
+    async def main():
+        clock = FakeClock()
+        order = []
+
+        async def sleeper(delay, tag):
+            await clock.sleep(delay)
+            order.append((tag, clock.time()))
+
+        tasks = [
+            asyncio.ensure_future(sleeper(3.0, "c")),
+            asyncio.ensure_future(sleeper(1.0, "a")),
+            asyncio.ensure_future(sleeper(2.0, "b")),
+        ]
+        await asyncio.sleep(0)  # everyone parked
+        await clock.advance(2.5)
+        assert order == [("a", 1.0), ("b", 2.0)]
+        assert clock.time() == 2.5
+        await clock.advance(1.0)
+        assert order[-1] == ("c", 3.0)
+        await asyncio.gather(*tasks)
+
+    run(main())
+
+
+def test_fake_clock_periodic_loop_ticks_per_window():
+    async def main():
+        clock = FakeClock()
+        ticks = []
+
+        async def loop():
+            while True:
+                await clock.sleep(1.0)
+                ticks.append(clock.time())
+
+        task = asyncio.ensure_future(loop())
+        await asyncio.sleep(0)
+        await clock.advance(3.0)  # one advance → three ticks
+        assert len(ticks) == 3
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+# -------------------------------------------------------- CircuitBreaker
+
+
+def test_breaker_trips_after_consecutive_failures_and_half_opens():
+    clock = FakeClock()
+    b = CircuitBreaker("t1", failure_threshold=3, reset_timeout=30.0, clock=clock)
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    b.record_failure()
+    # a success resets the CONSECUTIVE count
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED
+    b.record_failure()
+    assert b.state == OPEN
+    assert not b.allow()
+
+    # not yet: the reset timeout must elapse first
+    run(clock.advance(29.0))
+    assert not b.allow()
+    run(clock.advance(1.0))
+    assert b.allow()  # the probe
+    assert b.state == HALF_OPEN
+    assert not b.allow()  # only ONE probe at a time
+    # probe fails → fully open again, full timeout restarts
+    b.record_failure()
+    assert b.state == OPEN and not b.allow()
+    run(clock.advance(30.0))
+    assert b.allow()
+    b.record_success()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_cancelled_probe_releases_the_slot():
+    """A probe that ends NEUTRALLY (work cancelled mid-probe) must free
+    the half-open slot — otherwise the breaker wedges half-open with no
+    probe ever allowed again and the engine is lost for good."""
+    clock = FakeClock()
+    b = CircuitBreaker("t3", failure_threshold=1, reset_timeout=10.0, clock=clock)
+    b.record_failure()
+    run(clock.advance(10.0))
+    assert b.allow() and b.state == HALF_OPEN  # the probe slot is taken
+    assert not b.allow()
+    b.release_probe()  # probe was cancelled, not judged
+    assert b.allow()  # the NEXT call may probe
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_failover_cancelled_half_open_probe_does_not_wedge_breaker():
+    async def main():
+        clock = FakeClock()
+        primary = ScriptedBackend(script=["error", "cancelled"])
+        fallback = ScriptedBackend(work="00000000deadbeef")
+        chain = FailoverBackend(
+            [("a", primary), ("b", fallback)],
+            failure_threshold=1, reset_timeout=10.0, clock=clock,
+        )
+        await chain.setup()
+        await chain.generate(WorkRequest(random_hash(), 1))  # trips "a"
+        assert chain.breakers["a"].state == OPEN
+        await clock.advance(10.0)
+        # the half-open probe gets cancelled (the swarm resolved the hash)
+        with pytest.raises(WorkCancelled):
+            await chain.generate(WorkRequest(random_hash(), 1))
+        # the NEXT request can still probe — and "a" recovers
+        assert await chain.generate(WorkRequest(random_hash(), 1)) == primary.work
+        assert chain.breakers["a"].state == CLOSED
+
+    run(main())
+
+
+def test_breaker_state_on_metrics():
+    b = CircuitBreaker("t2", failure_threshold=1, reset_timeout=5.0,
+                       clock=FakeClock())
+    b.record_failure()
+    snap = obs.snapshot()
+    assert snap["dpow_breaker_state"]["series"]["t2"] == 1.0
+    assert snap["dpow_breaker_transitions_total"]["series"]["t2,open"] >= 1.0
+
+
+# ---------------------------------------------------- DispatchSupervisor
+
+
+class SupervisorHarness:
+    def __init__(self, grace=2.0, hedge_after=2):
+        self.clock = FakeClock()
+        self.published = []  # (hash, hedged)
+        self.answer = True  # what republish reports back
+        self.sup = DispatchSupervisor(
+            grace=grace, hedge_after=hedge_after,
+            republish=self._republish, clock=self.clock,
+        )
+
+    async def _republish(self, block_hash, hedged):
+        self.published.append((block_hash, hedged))
+        return self.answer
+
+
+def test_supervisor_republishes_after_grace_and_hedges():
+    async def main():
+        hx = SupervisorHarness(grace=2.0, hedge_after=2)
+        h = random_hash()
+        hx.sup.track(h, deadline=hx.clock.time() + 60.0)
+        hx.sup.dispatched(h)
+        task = asyncio.ensure_future(hx.sup.run())
+        await asyncio.sleep(0)
+        await hx.clock.advance(1.9)
+        assert hx.published == []  # inside grace
+        await hx.clock.advance(0.2)
+        assert hx.published == [(h, False)]  # first heal: plain republish
+        await hx.clock.advance(2.1)
+        assert hx.published == [(h, False), (h, True)]  # escalated: hedged
+        await hx.clock.advance(2.1)
+        assert hx.published[-1] == (h, True)  # stays hedged
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+def test_supervisor_activity_holds_the_redispatch():
+    async def main():
+        hx = SupervisorHarness(grace=2.0)
+        h = random_hash()
+        hx.sup.track(h, deadline=hx.clock.time() + 60.0)
+        hx.sup.dispatched(h)
+        task = asyncio.ensure_future(hx.sup.run())
+        await asyncio.sleep(0)
+        # a worker result lands every 1.5s: never a full silent window
+        for _ in range(4):
+            await hx.clock.advance(1.5)
+            hx.sup.activity(h)
+        assert hx.published == []
+        await hx.clock.advance(2.1)  # silence at last
+        assert hx.published == [(h, False)]
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+def test_supervisor_deadline_stops_retries_and_late_waiter_revives():
+    async def main():
+        hx = SupervisorHarness(grace=2.0)
+        h = random_hash()
+        hx.sup.track(h, deadline=hx.clock.time() + 5.0)
+        hx.sup.dispatched(h)
+        task = asyncio.ensure_future(hx.sup.run())
+        await asyncio.sleep(0)
+        await hx.clock.advance(10.0)
+        # heals at ~2 and ~4; deadline (5.0) gates everything after
+        assert len(hx.published) == 2
+        abandoned = obs.snapshot()[
+            "dpow_server_redispatch_abandoned_total"]["series"][""]
+        assert abandoned >= 1.0
+        # a NEW waiter with fresh budget revives supervision of the entry
+        hx.sup.track(h, deadline=hx.clock.time() + 60.0)
+        hx.sup.activity(h)  # re-arm the window from now
+        await hx.clock.advance(2.1)
+        assert len(hx.published) == 3
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+def test_supervisor_untracked_and_unpublished_hashes_stay_quiet():
+    async def main():
+        hx = SupervisorHarness(grace=1.0)
+        h1, h2 = random_hash(), random_hash()
+        hx.sup.track(h1, deadline=60.0)  # tracked but never dispatched
+        hx.sup.track(h2, deadline=60.0)
+        hx.sup.dispatched(h2)
+        hx.sup.untrack(h2)  # torn down before the first tick
+        task = asyncio.ensure_future(hx.sup.run())
+        await asyncio.sleep(0)
+        await hx.clock.advance(5.0)
+        assert hx.published == []
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+# ---------------------------------------------------------- DegradedStore
+
+
+def test_degraded_store_fails_over_journals_and_reconciles():
+    async def main():
+        clock = FakeClock()
+        schedule = FaultSchedule([
+            # every primary op fails for a while: a full outage window
+            # (setup burns one, the first recovery probe the other)
+            Rule(op="*", pattern="*", action=ERROR, times=2),
+        ])
+        primary = MemoryStore()
+        await primary.set("pre", "kept")  # pre-outage state
+        store = DegradedStore(
+            FaultyStore(primary, schedule), probe_interval=5.0, clock=clock,
+        )
+        await store.setup()  # hits the outage → degraded from the start
+        assert store.degraded
+        assert await store.get("pre") is None  # fallback knows nothing (yet)
+        await store.set("k", "v")  # journaled + fallback
+        assert await store.get("k") == "v"  # read-your-writes via fallback
+        await store.incrby("count", 3)
+        assert snapshot_gauge("dpow_store_degraded") == 1.0
+        assert snapshot_gauge("dpow_store_journal_depth") == 2.0
+
+        # primary still down at the first probe (rule has one error left)
+        await clock.advance(5.0)
+        assert await store.get("k") == "v"  # probe burned the last error
+        assert store.degraded
+
+        # next probe window: primary healthy → journal replays, mode exits
+        await clock.advance(5.0)
+        assert await store.get("pre") == "kept"  # pre-outage state is back
+        assert not store.degraded
+        assert await primary.get("k") == "v"  # reconciled write
+        assert await primary.get("count") == "3"  # reconciled delta
+        assert snapshot_gauge("dpow_store_degraded") == 0.0
+        assert snapshot_gauge("dpow_store_journal_depth") == 0.0
+
+    def snapshot_gauge(name):
+        return obs.snapshot()[name]["series"][""]
+
+    run(main())
+
+
+def test_degraded_store_journal_bound_sheds_oldest():
+    async def main():
+        clock = FakeClock()
+        schedule = FaultSchedule([Rule(op="get", action=ERROR, times=1)])
+        primary = MemoryStore()
+        store = DegradedStore(
+            FaultyStore(primary, schedule), probe_interval=1000.0,
+            max_journal=3, clock=clock,
+        )
+        await store.setup()
+        with pytest.raises(Exception):  # non-connection errors surface
+            await store.hset("x", "not-a-mapping")
+        assert not store.degraded  # TypeError is NOT a connection error
+        await store.get("trip")  # burn the one injected error → degraded
+        assert store.degraded
+        for i in range(5):
+            await store.set(f"k{i}", str(i))
+        before = obs.snapshot()["dpow_store_journal_dropped_total"]["series"][""]
+        assert before >= 2.0  # 5 writes into a 3-deep journal
+        # recovery replays only the surviving tail
+        await clock.advance(1000.0)
+        await store.get("anything")
+        assert not store.degraded
+        assert await primary.get("k0") is None  # shed
+        assert await primary.get("k4") == "4"  # survived
+
+    run(main())
+
+
+def test_degraded_store_drains_journal_in_bounded_bursts():
+    """A long outage's journal must not replay in one inline stall: each
+    op after the successful probe continues the drain by at most
+    ``reconcile_batch`` writes, and degraded mode ends only when empty."""
+
+    async def main():
+        clock = FakeClock()
+        schedule = FaultSchedule([Rule(op="get", action=ERROR, times=1)])
+        primary = MemoryStore()
+        store = DegradedStore(
+            FaultyStore(primary, schedule), probe_interval=5.0,
+            reconcile_batch=2, clock=clock,
+        )
+        await store.setup()
+        await store.get("trip")  # → degraded
+        assert store.degraded
+        for i in range(5):
+            await store.set(f"k{i}", str(i))
+        await clock.advance(5.0)
+        await store.get("x")  # probe ok → burst 1 replays 2 of 5
+        assert store.degraded
+        assert await primary.get("k1") == "1" and await primary.get("k2") is None
+        await store.get("x")  # burst 2 (no probe-interval wait mid-drain)
+        assert store.degraded
+        await store.get("x")  # burst 3 drains the last entry → recovered
+        assert not store.degraded
+        assert await primary.get("k4") == "4"
+
+    run(main())
+
+
+def test_degraded_store_concurrent_ops_never_double_replay():
+    """Only ONE op at a time may drive the recovery drain: a concurrent op
+    arriving mid-burst must serve from the fallback, not re-enter
+    _reconcile (which would replay the journal head twice and pop an entry
+    that never ran)."""
+
+    async def main():
+        clock = FakeClock()
+        schedule = FaultSchedule([Rule(op="get", action=ERROR, times=1)])
+
+        class GatedSet(MemoryStore):
+            def __init__(self):
+                super().__init__()
+                self.gate = asyncio.Event()
+                self.set_calls = []
+
+            async def set(self, key, value, expire=None):
+                self.set_calls.append(key)
+                await self.gate.wait()
+                await super().set(key, value, expire)
+
+        primary = GatedSet()
+        store = DegradedStore(
+            FaultyStore(primary, schedule), probe_interval=5.0, clock=clock,
+        )
+        await store.setup()
+        await store.get("trip")  # → degraded
+        for i in range(3):
+            await store.set(f"k{i}", str(i))
+        await clock.advance(5.0)
+        first = asyncio.ensure_future(store.get("a"))  # probes, starts drain
+        for _ in range(5):
+            await asyncio.sleep(0)  # first is parked inside the gated set
+        second = asyncio.ensure_future(store.get("b"))
+        for _ in range(5):
+            await asyncio.sleep(0)
+        # the second op did NOT join the drain (it would be parked on the
+        # gate too) — it served from the fallback and finished
+        assert second.done()
+        primary.gate.set()
+        await first
+        assert not store.degraded
+        # every journaled write replayed exactly once, in order
+        assert primary.set_calls == ["k0", "k1", "k2"]
+
+    run(main())
+
+
+def test_degraded_store_mirror_keeps_own_writes_visible_in_outage():
+    """Mutations made through the wrapper while HEALTHY are mirrored into
+    the fallback — so when the primary dies, this process's hot state
+    (service records, counters) is still there, and reads after recovery
+    see the primary again."""
+
+    async def main():
+        clock = FakeClock()
+        schedule = FaultSchedule(
+            [Rule(op="*", action=ERROR, times=2, after=4)]
+        )
+        store = DegradedStore(
+            FaultyStore(MemoryStore(), schedule), probe_interval=5.0,
+            clock=clock,
+        )
+        await store.setup()
+        await store.hset("service:svc", {"api_key": "hashed"})  # healthy (op 2: setup was 1)
+        await store.set("k", "v")  # healthy
+        assert await store.get("k") == "v"  # healthy (op 4)
+        await store.incrby("n")  # op 5 → the outage begins: ERROR
+        assert store.degraded
+        # the healthy-era writes survived into degraded mode via the mirror
+        assert await store.hget("service:svc", "api_key") == "hashed"
+        assert await store.get("k") == "v"
+        assert await store.incrby("n") == 2  # degraded retry continued the count
+
+    run(main())
+
+
+def test_get_store_degraded_prefix():
+    from tpu_dpow.store import get_store
+
+    store = get_store("degraded+memory")
+    assert isinstance(store, DegradedStore)
+    assert isinstance(store.primary, MemoryStore)
+
+
+# -------------------------------------------------------- FailoverBackend
+
+
+class ScriptedBackend(WorkBackend):
+    """Engine with a per-call script: 'ok', 'error', or 'cancelled'."""
+
+    def __init__(self, script=None, work="feedfacefeedface"):
+        self.script = list(script or [])
+        self.work = work
+        self.calls = 0
+        self.cancels = []
+        self.setup_ok = True
+
+    async def setup(self):
+        if not self.setup_ok:
+            raise WorkError("engine unavailable")
+
+    async def generate(self, request):
+        self.calls += 1
+        step = self.script.pop(0) if self.script else "ok"
+        if step == "error":
+            raise WorkError("scripted failure")
+        if step == "cancelled":
+            raise WorkCancelled(request.block_hash)
+        return self.work
+
+    async def cancel(self, block_hash):
+        self.cancels.append(block_hash)
+
+
+def test_failover_serves_from_fallback_and_breaker_skips_primary():
+    async def main():
+        clock = FakeClock()
+        primary = ScriptedBackend(script=["error"] * 10)
+        fallback = ScriptedBackend(work="0000feedfacebeef")
+        chain = FailoverBackend(
+            [("jax", primary), ("native", fallback)],
+            failure_threshold=3, reset_timeout=30.0, clock=clock,
+        )
+        await chain.setup()
+        req = lambda: WorkRequest(random_hash(), 1)  # noqa: E731
+        # three failures: each served by the fallback, breaker counts up
+        for _ in range(3):
+            assert await chain.generate(req()) == fallback.work
+        assert chain.breakers["jax"].state == OPEN
+        assert primary.calls == 3
+        # breaker open: the primary is not even tried
+        assert await chain.generate(req()) == fallback.work
+        assert primary.calls == 3
+        # reset elapses → half-open probe goes to the (now healthy) primary
+        primary.script = []
+        await clock.advance(30.0)
+        assert await chain.generate(req()) == primary.work
+        assert chain.breakers["jax"].state == CLOSED
+
+    run(main())
+
+
+def test_failover_cancel_routes_to_owner_and_cancelled_not_a_failure():
+    async def main():
+        primary = ScriptedBackend(script=["cancelled"])
+        fallback = ScriptedBackend()
+        chain = FailoverBackend([("a", primary), ("b", fallback)],
+                                failure_threshold=1)
+        await chain.setup()
+        with pytest.raises(WorkCancelled):
+            await chain.generate(WorkRequest(random_hash(), 1))
+        # a cancel is the swarm working as intended, not an engine fault
+        assert chain.breakers["a"].state == CLOSED
+        assert fallback.calls == 0
+
+    run(main())
+
+
+def test_failover_all_engines_down_is_work_error():
+    async def main():
+        a = ScriptedBackend(script=["error"] * 5)
+        b = ScriptedBackend(script=["error"] * 5)
+        chain = FailoverBackend([("a", a), ("b", b)], failure_threshold=5)
+        await chain.setup()
+        with pytest.raises(WorkError):
+            await chain.generate(WorkRequest(random_hash(), 1))
+
+    run(main())
+
+
+def test_failover_hang_detection_on_fake_clock():
+    async def main():
+        clock = FakeClock()
+
+        class HangingBackend(ScriptedBackend):
+            async def generate(self, request):
+                self.calls += 1
+                if self.calls == 1:
+                    await asyncio.get_running_loop().create_future()
+                return await super().generate(request)
+
+        primary = HangingBackend()
+        fallback = ScriptedBackend(work="00000000deadbeef")
+        chain = FailoverBackend(
+            [("a", primary), ("b", fallback)],
+            failure_threshold=3, hang_timeout=5.0, clock=clock,
+        )
+        await chain.setup()
+        gen = asyncio.ensure_future(chain.generate(WorkRequest(random_hash(), 1)))
+        for _ in range(5):  # let the hang-budget timer park on the clock
+            await asyncio.sleep(0)
+        await clock.advance(5.0)  # hang budget expires without a real sleep
+        assert await gen == fallback.work
+        assert chain.breakers["a"].failures == 1
+
+    run(main())
+
+
+def test_failover_dead_engine_dropped_at_setup():
+    async def main():
+        dead = ScriptedBackend()
+        dead.setup_ok = False
+        live = ScriptedBackend()
+        chain = FailoverBackend([("dead", dead), ("live", live)])
+        await chain.setup()  # does not raise: one engine is enough
+        assert await chain.generate(WorkRequest(random_hash(), 1)) == live.work
+        only_dead = FailoverBackend([("dead", ScriptedBackend())])
+        only_dead.backends[0][1].setup_ok = False
+        with pytest.raises(WorkError):
+            await only_dead.setup()
+
+    run(main())
+
+
+# --------------------------------------------- satellite: O(1) WorkQueue
+
+
+def test_workqueue_semantics_after_o1_rewrite():
+    async def main():
+        q = WorkQueue()
+        reqs = [WorkRequest(random_hash(), d + 1) for d in range(8)]
+        for r in reqs:
+            q.put(r)
+        assert len(q) == 8
+        assert reqs[3].block_hash in q
+        assert q.get(reqs[3].block_hash) is reqs[3]
+        assert random_hash() not in q
+
+        # replace keeps the slot, swaps the request
+        harder = WorkRequest(reqs[2].block_hash, 10**9)
+        assert q.replace(harder)
+        assert q.get(reqs[2].block_hash) is harder
+        assert not q.replace(WorkRequest(random_hash(), 1))
+        assert len(q) == 8
+
+        # remove: present and absent
+        assert q.remove(reqs[5].block_hash)
+        assert not q.remove(reqs[5].block_hash)
+        assert reqs[5].block_hash not in q
+        assert len(q) == 7
+
+        # pop drains every remaining item exactly once, in SOME order
+        popped = set()
+        for _ in range(7):
+            r = await q.pop_random()
+            assert r.block_hash not in popped
+            popped.add(r.block_hash)
+        assert popped == {r.block_hash for r in reqs if r is not reqs[5]}
+        assert len(q) == 0
+
+        # pop blocks on empty until a put arrives
+        waiter = asyncio.ensure_future(q.pop_random())
+        await asyncio.sleep(0)
+        assert not waiter.done()
+        q.put(reqs[0])
+        assert (await waiter).block_hash == reqs[0].block_hash
+
+    run(main())
+
+
+# ------------------------------------- satellite: broker queue-full drops
+
+
+def test_broker_queue_full_counts_and_warns_once(caplog, monkeypatch):
+    from tpu_dpow.transport import broker as broker_mod
+
+    async def main():
+        monkeypatch.setattr(broker_mod, "MAX_QUEUE", 4)
+        broker = Broker()
+        slow = InProcTransport(broker, client_id="slowpoke")
+        await slow.connect()
+        await slow.subscribe("work/#", qos=1)
+        fast = InProcTransport(broker, client_id="fast")
+        await fast.connect()
+        before = obs.snapshot()["dpow_broker_queue_full_drops_total"][
+            "series"].get("slowpoke", 0.0)
+        with caplog.at_level(logging.WARNING, logger="tpu_dpow.transport"):
+            for i in range(10):  # 6 past the queue bound
+                await fast.publish("work/ondemand", f"m{i}", qos=1)
+        drops = obs.snapshot()["dpow_broker_queue_full_drops_total"][
+            "series"]["slowpoke"]
+        assert drops - before == 6.0
+        warnings = [r for r in caplog.records if "queue full" in r.message]
+        assert len(warnings) == 1  # once per connection, not per message
+        # oldest-first shed: the newest 4 messages survive
+        kept = []
+        async def drain():
+            async for m in slow.messages():
+                kept.append(m.payload)
+                if len(kept) == 4:
+                    return
+        await asyncio.wait_for(drain(), 5)
+        assert kept == ["m6", "m7", "m8", "m9"]
+        # a RECONNECT re-arms the warning
+        await slow.close()
+        await slow.connect()
+        await slow.subscribe("work/#", qos=1)
+        with caplog.at_level(logging.WARNING, logger="tpu_dpow.transport"):
+            caplog.clear()
+            for i in range(6):
+                await fast.publish("work/ondemand", f"n{i}", qos=1)
+        assert any("queue full" in r.message for r in caplog.records)
+
+    run(main())
+
+
+# --------------------------------- satellite: heartbeat watchdog metrics
+
+
+class NullBackend(WorkBackend):
+    async def setup(self):
+        pass
+
+    async def generate(self, request):  # pragma: no cover - never driven
+        await asyncio.get_running_loop().create_future()
+
+    async def cancel(self, block_hash):
+        pass
+
+
+def test_heartbeat_watchdog_gauge_and_transitions():
+    async def main():
+        broker = Broker()
+        config = ClientConfig(payout_address="", heartbeat_timeout=10.0)
+        client = DpowClient(
+            config, InProcTransport(broker, client_id="w"), backend=NullBackend()
+        )
+        obs.get_registry().reset()
+        gauge = lambda: obs.snapshot()[  # noqa: E731
+            "dpow_client_heartbeat_stale_seconds"]["series"].get("", 0.0)
+        trans = lambda: obs.snapshot()[  # noqa: E731
+            "dpow_client_heartbeat_stale_transitions_total"]["series"].get("", 0.0)
+
+        client.last_heartbeat = 100.0
+        client._heartbeat_tick(105.0)  # fresh
+        assert gauge() == 0.0 and client._server_online
+        client._heartbeat_tick(125.0)  # 25s of silence: stale
+        assert gauge() == 25.0 and not client._server_online
+        assert trans() == 1.0
+        client._heartbeat_tick(130.0)  # still stale: gauge tracks, no re-log
+        assert gauge() == 30.0 and trans() == 1.0
+        client.last_heartbeat = 130.0  # heartbeat returns
+        client._heartbeat_tick(131.0)
+        assert gauge() == 0.0 and client._server_online
+        # watchdog RE-ARMS: a second outage alarms again
+        client._heartbeat_tick(145.0)
+        assert trans() == 2.0 and gauge() == 15.0
+
+    run(main())

@@ -791,6 +791,38 @@ def test_republish_carries_raised_target():
     run(main())
 
 
+def test_too_weak_results_do_not_suppress_republish():
+    """Supervisor activity must count only VALID results: a worker stuck
+    grinding a stale weaker target (its re-target publish was lost)
+    streams too-weak results — if those held the grace window, the one
+    re-publish that would heal it could never fire."""
+
+    async def main():
+        async with Harness(work_republish_interval=0.2) as hx:
+            h = random_hash()
+            raised = nc.derive_work_difficulty(4.0, EASY_BASE)
+            task = asyncio.ensure_future(
+                hx.server.service_handler(hx.request(h, multiplier=4.0, timeout=10))
+            )
+            await asyncio.sleep(0.05)
+            t = await hx.start_worker(respond=False)  # observe only
+            weak = solve(h, EASY_BASE, below=raised)
+            # stream invalid (too-weak) results FASTER than the grace window
+            for _ in range(6):
+                await t.publish("result/ondemand", f"{h},{weak},{ACCOUNT}")
+                await asyncio.sleep(0.1)
+            republished = [
+                m for m in hx.worker_log if m.topic == "work/ondemand"
+            ]
+            assert republished, "invalid results held back the re-dispatch"
+            strong = solve(h, raised)
+            await t.publish("result/ondemand", f"{h},{strong},{ACCOUNT}")
+            resp = await asyncio.wait_for(task, 10)
+            assert resp["work"] == strong
+
+    run(main())
+
+
 def test_republish_stops_when_frontier_retires_the_hash():
     """A hash whose `block:` key was retired (frontier moved on) must not
     keep being re-announced: the result handler drops all results for it,

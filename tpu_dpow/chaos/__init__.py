@@ -1,0 +1,37 @@
+"""Chaos layer: deterministic, seedable fault injection for every seam.
+
+The stack has exactly three dependency seams — Transport, Store,
+WorkBackend — and this package ships a fault-injecting wrapper for each,
+all driven by one scripted :class:`FaultSchedule`:
+
+  FaultyTransport — drop / delay / duplicate / reorder / disconnect,
+                    per direction, per topic pattern;
+  FaultyStore     — connection errors, delays, hangs, per key pattern;
+  FaultyBackend   — WorkError, hang-until-cancel, wrong nonces, delays,
+                    per block hash.
+
+Everything is deterministic: counts are exact, probabilistic rules draw
+from the schedule's seeded RNG, and every delay runs on an injectable
+clock (:class:`FakeClock`, re-exported from tpu_dpow.resilience) — so a
+full drop/re-dispatch/recover scenario plays out in milliseconds of wall
+time inside tier-1. Chaos tests carry the ``chaos`` pytest marker; the
+end-to-end scripted scenario lives in scripts/chaos_demo.py.
+"""
+
+from ..resilience.clock import FakeClock, SystemClock  # noqa: F401
+from .backend import FaultyBackend, invalid_work_for  # noqa: F401
+from .schedule import (  # noqa: F401
+    ACTIONS,
+    DELAY,
+    DISCONNECT,
+    DROP,
+    DUPLICATE,
+    ERROR,
+    HANG,
+    REORDER,
+    WRONG_WORK,
+    FaultSchedule,
+    Rule,
+)
+from .store import FaultyStore  # noqa: F401
+from .transport import FaultyTransport  # noqa: F401

@@ -46,22 +46,7 @@ class DpowClient:
         self.config = config
         self.transport = transport
         if backend is None:
-            # Per-backend knobs: batching is the jax engine's concept, the
-            # worker URI the subprocess backend's; native takes neither.
-            kwargs = {}
-            if config.backend == "subprocess":
-                kwargs["uri"] = config.worker_uri
-            elif config.backend == "jax":
-                kwargs["max_batch"] = config.max_batch
-                kwargs["mesh_devices"] = config.mesh_devices
-                if config.run_steps > 0:
-                    kwargs["run_steps"] = config.run_steps
-                if config.pipeline > 0:
-                    kwargs["pipeline"] = config.pipeline
-                kwargs["step_ladder"] = config.step_ladder
-                if config.shared_steps_cap > 0:
-                    kwargs["shared_steps_cap"] = config.shared_steps_cap
-            backend = get_backend(config.backend, **kwargs)
+            backend = self._build_backend(config)
         # The handler's in-flight cap must exceed the engine's batch size or
         # the batched launch can never fill (the queue would starve it at 8
         # like the reference's one-at-a-time worker dialogue); 2x keeps the
@@ -86,8 +71,58 @@ class DpowClient:
         self._m_results_published = reg.counter(
             "dpow_client_results_published_total",
             "Solved results published to the broker", ("work_type",))
+        # Heartbeat watchdog, scrapeable: before this the staleness alarm
+        # was a single log line — a fleet dashboard could not tell a quiet
+        # worker from one whose server link died minutes ago.
+        self._m_heartbeat_stale = reg.gauge(
+            "dpow_client_heartbeat_stale_seconds",
+            "Seconds since the last server heartbeat while past the "
+            "staleness budget (0 while the feed is healthy)")
+        self._m_stale_transitions = reg.counter(
+            "dpow_client_heartbeat_stale_transitions_total",
+            "Times the server heartbeat went from live to stale")
 
     # -- wiring ---------------------------------------------------------
+
+    @staticmethod
+    def _backend_kwargs(config: ClientConfig, name: str) -> dict:
+        """Per-backend knobs: batching is the jax engine's concept, the
+        worker URI the subprocess backend's; native takes neither."""
+        kwargs = {}
+        if name == "subprocess":
+            kwargs["uri"] = config.worker_uri
+        elif name == "jax":
+            kwargs["max_batch"] = config.max_batch
+            kwargs["mesh_devices"] = config.mesh_devices
+            if config.run_steps > 0:
+                kwargs["run_steps"] = config.run_steps
+            if config.pipeline > 0:
+                kwargs["pipeline"] = config.pipeline
+            kwargs["step_ladder"] = config.step_ladder
+            if config.shared_steps_cap > 0:
+                kwargs["shared_steps_cap"] = config.shared_steps_cap
+        return kwargs
+
+    @classmethod
+    def _build_backend(cls, config: ClientConfig) -> WorkBackend:
+        """The configured engine — or, with --backend_fallback, the whole
+        failover chain behind per-engine circuit breakers
+        (resilience/failover.py): a primary that errors or hangs trips its
+        breaker and the fallback serves, instead of every request dying
+        with the reference's log-and-drop."""
+        names = [config.backend] + [
+            n.strip() for n in config.backend_fallback.split(",") if n.strip()
+        ]
+        if len(names) == 1:
+            return get_backend(names[0], **cls._backend_kwargs(config, names[0]))
+        from ..resilience import FailoverBackend
+
+        return FailoverBackend(
+            [(n, get_backend(n, **cls._backend_kwargs(config, n))) for n in names],
+            failure_threshold=config.breaker_failures,
+            reset_timeout=config.breaker_reset,
+            hang_timeout=config.backend_hang_timeout,
+        )
 
     async def _send_result(self, request: WorkRequest, work: str) -> None:
         await self.transport.publish(
@@ -115,6 +150,11 @@ class DpowClient:
                 "Server is offline (no heartbeat within "
                 f"{self.config.startup_heartbeat_wait}s)"
             )
+        # Re-arm the watchdog: a reconnect after a long outage starts from
+        # a PROVEN-live feed (the heartbeat above), so the stale state and
+        # its gauge must clear here, not linger until the first loop tick.
+        self._server_online = True
+        self._m_heartbeat_stale.set(0.0)
         for work_type in self.config.work_type.topics:
             await self.transport.subscribe(f"work/{work_type}", qos=QOS_0)
             await self.transport.subscribe(f"cancel/{work_type}", qos=QOS_1)
@@ -216,21 +256,32 @@ class DpowClient:
             except Exception:
                 logger.error("message handling failed:\n%s", traceback.format_exc())
 
+    def _heartbeat_tick(self, now: float) -> None:
+        """One watchdog evaluation (split from the loop so tests drive it
+        with synthetic clocks instead of sleeping through real seconds).
+        Logs once per fresh→stale transition; the gauge tracks the live
+        silence while stale and pins to 0 on recovery, so the alarm both
+        raises and CLEARS on a dashboard."""
+        if self.last_heartbeat is None:
+            return
+        silence = now - self.last_heartbeat
+        stale = silence > self.config.heartbeat_timeout
+        self._m_heartbeat_stale.set(silence if stale else 0.0)
+        if stale and self._server_online:
+            self._server_online = False
+            self._m_stale_transitions.inc()
+            logger.warning(
+                "server heartbeat lost (%.0fs); connection may be dead", silence
+            )
+        elif not stale and not self._server_online:
+            self._server_online = True
+            logger.info("server heartbeat recovered")
+
     async def _heartbeat_check_loop(self) -> None:
         """Staleness watchdog (reference :167-179)."""
         while True:
             await asyncio.sleep(1.0)
-            if self.last_heartbeat is None:
-                continue
-            silence = time.monotonic() - self.last_heartbeat
-            if silence > self.config.heartbeat_timeout and self._server_online:
-                self._server_online = False
-                logger.warning(
-                    "server heartbeat lost (%.0fs); connection may be dead", silence
-                )
-            elif silence <= self.config.heartbeat_timeout and not self._server_online:
-                self._server_online = True
-                logger.info("server heartbeat recovered")
+            self._heartbeat_tick(time.monotonic())
 
     def start_loops(self) -> None:
         self._tasks = [

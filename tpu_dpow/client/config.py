@@ -16,6 +16,13 @@ class ClientConfig:
     payout_address: str = ""
     work_type: WorkType = WorkType.ANY
     backend: str = "jax"  # jax | native | subprocess
+    # Comma-separated fallback engines tried (in order) when the primary
+    # fails or its circuit breaker is open, e.g. "native". Empty = no chain:
+    # a backend failure is an error response, as in the reference.
+    backend_fallback: str = ""
+    breaker_failures: int = 3  # consecutive failures that trip an engine
+    breaker_reset: float = 30.0  # seconds open before a half-open probe
+    backend_hang_timeout: float = 0.0  # generate() hang budget (0 = off)
     worker_uri: str = "http://127.0.0.1:7000"  # for backend=subprocess
     heartbeat_timeout: float = 10.0  # alarm when server heartbeats stop
     startup_heartbeat_wait: float = 2.0  # refuse to start without a live server
@@ -45,6 +52,10 @@ class ClientConfig:
             raise ValueError("--pipeline must be >= 0 (0 = auto)")
         if self.shared_steps_cap < 0:
             raise ValueError("--shared_steps_cap must be >= 0 (0 = auto)")
+        if self.breaker_failures < 1:
+            raise ValueError("--breaker_failures must be >= 1")
+        if self.backend_hang_timeout < 0:
+            raise ValueError("--backend_hang_timeout must be >= 0 (0 = off)")
         if self.payout_address:
             self.payout_address = self.payout_address.replace("xrb_", "nano_")
             nc.validate_account(self.payout_address)
@@ -64,6 +75,19 @@ def parse_args(argv=None) -> ClientConfig:
                    choices=["any", "ondemand", "precache"])
     p.add_argument("--backend", default=c.backend,
                    choices=["jax", "native", "subprocess"])
+    p.add_argument("--backend_fallback", default=c.backend_fallback,
+                   help="comma-separated fallback engines behind circuit "
+                   "breakers, tried in order when the primary fails "
+                   "(e.g. 'native'); empty = no failover chain")
+    p.add_argument("--breaker_failures", type=int, default=c.breaker_failures,
+                   help="consecutive failures that trip an engine's breaker")
+    p.add_argument("--breaker_reset", type=float, default=c.breaker_reset,
+                   help="seconds an engine's breaker stays open before a "
+                   "half-open probe request is let through")
+    p.add_argument("--backend_hang_timeout", type=float,
+                   default=c.backend_hang_timeout,
+                   help="seconds a generate() may run before it counts as a "
+                   "hang and fails over (0 = no hang detection)")
     p.add_argument("--worker_uri", default=c.worker_uri,
                    help="external work server (backend=subprocess)")
     p.add_argument("--max_batch", type=int, default=c.max_batch)

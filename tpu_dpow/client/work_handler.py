@@ -37,51 +37,67 @@ ResultCallback = Callable[[WorkRequest, str], Awaitable[None]]
 
 
 class WorkQueue:
-    """Async queue with membership tests and random pop (reference :9-36)."""
+    """Async queue with membership tests and random pop (reference :9-36).
+
+    Backed by a hash→request dict plus a swap-with-last index over the
+    hashes, so every operation the enqueue-dedup hot path runs
+    (``__contains__``/``get``/``replace``) — and removal itself — is O(1).
+    The previous list-scan implementation was O(n) per duplicate work
+    message, i.e. O(n²) when a republishing server re-announces into a
+    deep backlog. Random pop order is preserved: the index is an unordered
+    set-with-choice, swap-with-last keeps no positional meaning.
+    """
 
     def __init__(self):
-        self._items: list = []
+        self._items: Dict[str, WorkRequest] = {}  # hash → queued request
+        self._order: list = []  # hashes, arbitrary order (random pop)
+        self._index: Dict[str, int] = {}  # hash → its slot in _order
         self._waiter: asyncio.Event = asyncio.Event()
 
     def __contains__(self, block_hash: str) -> bool:
-        return any(r.block_hash == block_hash for r in self._items)
+        return block_hash in self._items
 
     def __len__(self) -> int:
-        return len(self._items)
+        return len(self._order)
 
     def put(self, request: WorkRequest) -> None:
-        self._items.append(request)
+        block_hash = request.block_hash
+        if block_hash not in self._items:
+            self._index[block_hash] = len(self._order)
+            self._order.append(block_hash)
+        self._items[block_hash] = request
         self._waiter.set()
 
+    def _pop_hash(self, block_hash: str) -> WorkRequest:
+        """Drop a known-present hash in O(1): swap its slot with the last."""
+        i = self._index.pop(block_hash)
+        last = self._order.pop()
+        if last != block_hash:
+            self._order[i] = last
+            self._index[last] = i
+        return self._items.pop(block_hash)
+
     def remove(self, block_hash: str) -> bool:
-        for i, r in enumerate(self._items):
-            if r.block_hash == block_hash:
-                del self._items[i]
-                return True
-        return False
+        if block_hash not in self._items:
+            return False
+        self._pop_hash(block_hash)
+        return True
 
     def get(self, block_hash: str) -> Optional[WorkRequest]:
-        for r in self._items:
-            if r.block_hash == block_hash:
-                return r
-        return None
+        return self._items.get(block_hash)
 
     def replace(self, request: WorkRequest) -> bool:
         """Swap the queued entry for this hash in place (same queue slot)."""
-        for i, r in enumerate(self._items):
-            if r.block_hash == request.block_hash:
-                self._items[i] = request
-                return True
-        return False
+        if request.block_hash not in self._items:
+            return False
+        self._items[request.block_hash] = request
+        return True
 
     async def pop_random(self) -> WorkRequest:
-        while not self._items:
+        while not self._order:
             self._waiter.clear()
             await self._waiter.wait()
-        i = random.randrange(len(self._items))
-        item = self._items[i]
-        del self._items[i]
-        return item
+        return self._pop_hash(self._order[random.randrange(len(self._order))])
 
 
 class _OngoingJob:

@@ -1,0 +1,32 @@
+"""Resilience layer: the stack's answer to churn, outages and dead engines.
+
+The reference DPoW hub survives volunteer-client churn only by luck — a
+work publish with no listener strands the service waiter until timeout, a
+Redis outage is fatal, a wedged work server takes its client down with it.
+This package makes each of those failure modes a handled state with an
+exported metric:
+
+  supervisor — :class:`DispatchSupervisor`: per-dispatch deadlines,
+               grace-window re-publish, hedged duplicate dispatch
+               (server-side; wired in server/app.py);
+  breaker    — :class:`CircuitBreaker`: closed/open/half-open with a
+               probe, on an injectable clock;
+  failover   — :class:`FailoverBackend`: jax → native → error engine
+               chain behind per-engine breakers (client-side);
+  clock      — :class:`SystemClock` / :class:`FakeClock`: the injectable
+               time seam every timer here runs on, so chaos tests advance
+               hours in microseconds (tpu_dpow/chaos reuses it).
+
+The store-side half lives next to the stores it wraps:
+:class:`~tpu_dpow.store.degraded.DegradedStore` (re-exported here) falls
+back from a dead primary to in-memory, journals writes, and reconciles on
+recovery.
+
+See docs/resilience.md for the state machines and the metric families.
+"""
+
+from ..store.degraded import DegradedStore  # noqa: F401
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: F401
+from .clock import Clock, FakeClock, SystemClock  # noqa: F401
+from .failover import FailoverBackend  # noqa: F401
+from .supervisor import DispatchSupervisor  # noqa: F401

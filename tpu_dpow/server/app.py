@@ -31,6 +31,7 @@ from typing import Dict, Optional
 
 from .. import obs
 from ..models import DifficultyModel, WorkType
+from ..resilience import DispatchSupervisor, SystemClock
 from ..store import MemoryStore, Store
 from ..transport import Message, QOS_0, QOS_1, Transport
 from ..transport.mqtt_codec import encode_work_payload, parse_result_payload
@@ -56,10 +57,14 @@ class DpowServer:
         config: ServerConfig,
         store: Store,
         transport: Transport,
+        clock=None,
     ):
         self.config = config
         self.store = store
         self.transport = transport
+        # Injectable time (resilience/clock.py): chaos tests hand in a
+        # FakeClock and play hours of grace windows in milliseconds.
+        self.clock = clock or SystemClock()
         self.difficulty_model = DifficultyModel(
             base_difficulty=config.base_difficulty,
             max_multiplier=config.max_multiplier,
@@ -74,10 +79,19 @@ class DpowServer:
         # difficulty it was published). Entries live and die with the
         # work_futures entry for the same hash.
         self._dispatched_difficulty: Dict[str, int] = {}
-        # When each in-flight hash was last published to work/ondemand —
-        # the re-publish loop heals publishes lost to dead/reconnecting
-        # workers (work rides QoS 0). Entries live and die with work_futures.
-        self._last_publish: Dict[str, float] = {}
+        # Re-dispatch supervision (resilience/supervisor.py): each in-flight
+        # dispatch is tracked with its waiters' deadline; a hash with no
+        # publish and no worker result for a full grace window gets its
+        # work re-published, escalating to hedged dispatch (both work
+        # topics) after `hedge_after` attempts. Heals publishes lost to
+        # dead/reconnecting workers (work rides QoS 0). Entries live and
+        # die with work_futures.
+        self.supervisor = DispatchSupervisor(
+            grace=config.work_republish_interval or 1.0,
+            hedge_after=config.hedge_after,
+            republish=self._republish_work,
+            clock=self.clock,
+        )
         # Per-hash: serializes the dispatcher's difficulty-entry write with
         # concurrent raisers for the SAME hash, so interleaved store writes
         # cannot leave `block-difficulty:` below what was last published.
@@ -145,7 +159,7 @@ class DpowServer:
             asyncio.ensure_future(self._statistics_loop()),
         ]
         if self.config.work_republish_interval > 0:
-            self._tasks.append(asyncio.ensure_future(self._work_republish_loop()))
+            self._tasks.append(asyncio.ensure_future(self.supervisor.run()))
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
 
@@ -192,70 +206,59 @@ class DpowServer:
             except Exception as e:
                 logger.warning("statistics publish failed: %s", e)
 
-    async def _work_republish_loop(self) -> None:
-        """Heal lost work publishes for still-unresolved dispatches.
+    async def _republish_work(self, block_hash: str, hedged: bool) -> bool:
+        """Supervisor callback: heal a lost work publish for one dispatch.
 
         work/ondemand rides QoS 0 by design (a stale duplicate delivered
         minutes later would waste lanes), so a publish that fired while
         every worker was dead or mid-reconnect is simply gone — the
         reference strands those waiters until timeout and expects the
-        service to retry (its dpow_server.py has no analog). Here any hash
-        still carrying an unresolved future `work_republish_interval` after
-        its last publish is re-published at its current (possibly raised)
-        target; workers already scanning it dedup the repeat on enqueue
+        service to retry (its dpow_server.py has no analog). The supervisor
+        calls here for any hash whose dispatch has been silent (no publish,
+        no worker result) for a full grace window; the re-publish goes out
+        at the current (possibly raised) target, and workers already
+        scanning the hash dedup the repeat on enqueue
         (client/work_handler.py queue_work), so the heal costs nothing in
-        the healthy case.
+        the healthy case. A HEDGED re-dispatch (escalation after repeated
+        silence) also publishes to work/precache: precache-only workers are
+        recruited onto the stalled hash — the result handler keys the work
+        type off the store, not the topic, so accounting stays correct.
+
+        Returns True iff something was published (the supervisor re-arms
+        its grace window only then).
         """
-        interval = self.config.work_republish_interval
-        while True:
-            await asyncio.sleep(interval)
-            now = time.monotonic()
-            for block_hash, fut in list(self.work_futures.items()):
-                last = self._last_publish.get(block_hash)
-                if last is None:
-                    # No recorded publish = the dispatcher is still mid-
-                    # dispatch (it stamps only after its lock-protected
-                    # publish). Publishing here would jump its difficulty-
-                    # entry serialization — it will publish momentarily.
-                    continue
-                if now - last < interval:
-                    continue
-                # Earlier iterations' awaits may have let this hash resolve
-                # or tear down; a stale publish would set workers grinding
-                # work nobody waits for, with no cancel fan-out behind it.
-                if self.work_futures.get(block_hash) is not fut or fut.done():
-                    continue
-                # Work no longer wanted at the store level — the frontier
-                # moved on (block_arrival retired the key) or a result
-                # already landed. The result handler drops everything for
-                # such a hash, so re-announcing it would have workers grind
-                # a dead target once per interval until the waiter times
-                # out. Let the waiter run out quietly instead.
-                avail = await self.store.get(f"block:{block_hash}")
-                if avail != WORK_PENDING:
-                    continue
-                difficulty = self._dispatched_difficulty.get(
-                    block_hash, self.config.base_difficulty
-                )
-                try:
-                    await self.transport.publish(
-                        "work/ondemand",
-                        encode_work_payload(
-                            block_hash, difficulty, self._tracer.id_for(block_hash)
-                        ),
-                        qos=QOS_0,
-                    )
-                    self.work_republished += 1
-                    self._m_republished.inc()
-                    logger.info("re-published pending work for %s", block_hash)
-                except Exception as e:
-                    logger.warning("work re-publish failed: %s", e)
-                    continue
-                # Re-stamp only while the entry is still live — the waiter
-                # teardown popping during our publish await must win, or
-                # every hash that races a republish tick leaks an entry.
-                if self.work_futures.get(block_hash) is fut:
-                    self._last_publish[block_hash] = time.monotonic()
+        fut = self.work_futures.get(block_hash)
+        if fut is None or fut.done():
+            return False
+        # Work no longer wanted at the store level — the frontier moved on
+        # (block_arrival retired the key) or a result already landed. The
+        # result handler drops everything for such a hash, so re-announcing
+        # it would have workers grind a dead target once per grace window
+        # until the waiter times out. Let the waiter run out quietly.
+        avail = await self.store.get(f"block:{block_hash}")
+        if avail != WORK_PENDING:
+            return False
+        # The store await may have let this hash resolve or tear down; a
+        # stale publish would set workers grinding work nobody waits for,
+        # with no cancel fan-out behind it.
+        if self.work_futures.get(block_hash) is not fut or fut.done():
+            return False
+        difficulty = self._dispatched_difficulty.get(
+            block_hash, self.config.base_difficulty
+        )
+        payload = encode_work_payload(
+            block_hash, difficulty, self._tracer.id_for(block_hash)
+        )
+        await self.transport.publish("work/ondemand", payload, qos=QOS_0)
+        if hedged:
+            await self.transport.publish("work/precache", payload, qos=QOS_0)
+        self.work_republished += 1
+        self._m_republished.inc()
+        logger.info(
+            "re-published pending work for %s%s",
+            block_hash, " (hedged)" if hedged else "",
+        )
+        return True
 
     async def _checkpoint_loop(self) -> None:
         while True:
@@ -344,6 +347,14 @@ class DpowServer:
             self._m_results.inc(1, "invalid")
             return
 
+        # A VALID result (winning or not) proves workers are alive at the
+        # CURRENT target; hold the supervisor's re-dispatch. Deliberately
+        # after validation: a worker grinding a stale weaker target (its
+        # re-target publish was lost) streams too-weak results, and
+        # counting those as activity would suppress the exact re-publish
+        # that heals it.
+        self.supervisor.activity(block_hash)
+
         # Winner election: exactly one result claims the lock
         # (reference dpow_server.py:138).
         if not await self.store.setnx(
@@ -361,6 +372,10 @@ class DpowServer:
             # the live request's trace before validation rejected it.
             self._tracer.alias(block_hash, trace_id)
         self._tracer.mark_hash(block_hash, "winner")
+        # Read BEFORE resolving the future: the moment set_result runs, any
+        # await below can hand the loop to the last waiter's teardown,
+        # which untracks the dispatch — and the hedged flag with it.
+        hedged = self.supervisor.was_hedged(block_hash)
         await self.store.set(f"block:{block_hash}", work, expire=self.config.block_expiry)
 
         future = self.work_futures.get(block_hash)
@@ -369,6 +384,17 @@ class DpowServer:
 
         # Tell everyone else to stop burning lanes on this hash.
         await self.transport.publish(f"cancel/{work_type}", block_hash, qos=QOS_1)
+        if hedged:
+            # Hedged dispatch recruited workers off the OTHER work topic;
+            # they subscribe only that topic's cancel channel, so the
+            # fan-out must mirror the hedge or they grind the resolved
+            # hash until their own scans exhaust.
+            other = (
+                WorkType.PRECACHE.value
+                if work_type == WorkType.ONDEMAND.value
+                else WorkType.ONDEMAND.value
+            )
+            await self.transport.publish(f"cancel/{other}", block_hash, qos=QOS_1)
         self._m_cancels.inc()
         self._tracer.mark_hash(block_hash, "cancel")
 
@@ -489,7 +515,7 @@ class DpowServer:
         del self.work_futures[block_hash]
         self._dispatched_difficulty.pop(block_hash, None)
         self._difficulty_locks.pop(block_hash, None)
-        self._last_publish.pop(block_hash, None)
+        self.supervisor.untrack(block_hash)
         self._m_dispatches.set(len(self.work_futures))
 
     async def _authenticate(self, data: dict) -> str:
@@ -649,6 +675,11 @@ class DpowServer:
             self._dispatched_difficulty[block_hash] = difficulty
             self._m_dispatches.set(len(self.work_futures))
             self._tracer.mark_hash(block_hash, "queue")
+            # Supervision starts with the entry (deadline = this waiter's
+            # budget); the supervisor holds fire until the first publish is
+            # stamped via dispatched(), so it cannot jump the dispatcher's
+            # difficulty-entry serialization below.
+            self.supervisor.track(block_hash, self.clock.time() + timeout)
             try:
                 if account:
                     asyncio.ensure_future(
@@ -697,7 +728,7 @@ class DpowServer:
                         ),
                         qos=QOS_0,
                     )
-                    self._last_publish[block_hash] = time.monotonic()
+                    self.supervisor.dispatched(block_hash)
                     self._tracer.mark_hash(block_hash, "publish")
             except BaseException:
                 # A failed dispatch must not leave a never-resolved future
@@ -720,6 +751,11 @@ class DpowServer:
         # membership check above and this line, so the key lookup is safe.
         fut = created if created is not None else self.work_futures[block_hash]
         self._future_waiters[block_hash] = self._future_waiters.get(block_hash, 0) + 1
+        # Deadline propagation: every waiter extends supervision to its own
+        # budget (the latest deadline wins), so re-dispatch retries keep
+        # healing for exactly as long as some waiter can still be answered
+        # — and never longer.
+        self.supervisor.track(block_hash, self.clock.time() + timeout)
         try:
             if created is None and difficulty > self._dispatched_difficulty.get(
                 block_hash, self.config.base_difficulty
@@ -772,7 +808,7 @@ class DpowServer:
                         except BaseException:
                             self._dispatched_difficulty[block_hash] = current
                             raise
-                        self._last_publish[block_hash] = time.monotonic()
+                        self.supervisor.dispatched(block_hash)
                         logger.info(
                             "re-targeted in-flight %s to %016x", block_hash, difficulty
                         )

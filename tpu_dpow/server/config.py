@@ -57,6 +57,11 @@ class ServerConfig:
     # retry); here the orchestrator heals it — client-side enqueue dedup
     # makes the repeat publish free for workers already on the job.
     work_republish_interval: float = 2.0
+    # From this re-dispatch attempt on, the supervisor HEDGES: the work is
+    # also published to work/precache, recruiting workers outside the
+    # hash's own pool (a precache-only fleet picks up a stalled on-demand
+    # hash rather than letting the request die). 1 = hedge immediately.
+    hedge_after: int = 2
     log_file: Optional[str] = None
 
 
@@ -87,6 +92,9 @@ def parse_args(argv=None) -> ServerConfig:
                    help="re-publish work for still-unsolved dispatches after "
                    "this many seconds (0 disables) — heals QoS-0 work "
                    "messages lost to dead or reconnecting workers")
+    p.add_argument("--hedge_after", type=int, default=c.hedge_after,
+                   help="escalate to hedged dispatch (work/ondemand AND "
+                   "work/precache) from this re-dispatch attempt on")
     p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
                    help="seconds between public statistics broadcasts "
                    "(reference: fixed 300)")

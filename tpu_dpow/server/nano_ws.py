@@ -12,7 +12,12 @@ import asyncio
 import json
 from typing import Awaitable, Callable, Optional
 
-import websockets
+try:  # gated: this environment may not ship the websockets package —
+    # the client is still constructible (and fully testable) with an
+    # injected ``connect`` factory.
+    import websockets
+except ImportError:  # pragma: no cover - depends on the environment
+    websockets = None
 
 from ..utils.logging import get_logger
 
@@ -26,10 +31,24 @@ class NanoWebsocketClient:
         callback: Callable[[dict], Awaitable[None]],
         *,
         reconnect_interval: float = 30.0,
+        connect: Optional[Callable] = None,
+        sleep: Optional[Callable[[float], Awaitable[None]]] = None,
     ):
         self.uri = uri
         self.callback = callback
         self.reconnect_interval = reconnect_interval
+        # Injectable seams: tests hand in a scripted connection factory and
+        # a recording sleep, so the reconnect-backoff schedule is assertable
+        # without a real node or a single real sleep.
+        if connect is None:
+            if websockets is None:
+                raise RuntimeError(
+                    "the websockets package is not installed; pass an "
+                    "explicit connect= factory"
+                )
+            connect = websockets.connect
+        self._connect = connect
+        self._sleep = sleep or asyncio.sleep
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
@@ -46,7 +65,7 @@ class NanoWebsocketClient:
         delay = 1.0
         while not self._stopped:
             try:
-                async with websockets.connect(self.uri) as ws:
+                async with self._connect(self.uri) as ws:
                     await self._subscribe(ws)
                     async for raw in ws:
                         # Reset backoff only once the FEED is proven live —
@@ -92,7 +111,7 @@ class NanoWebsocketClient:
                 # Clean server-side close: without a pause here, a node that
                 # accepts + acks + closes would spin a hot reconnect loop.
                 logger.info("node websocket closed; reconnecting in %.0fs", delay)
-            await asyncio.sleep(delay)
+            await self._sleep(delay)
             delay = min(delay * 2, self.reconnect_interval)
 
     def start(self) -> None:

@@ -12,6 +12,10 @@ injectable protocol with two implementations:
     the test seam the reference never had.
   * :class:`~tpu_dpow.store.redis_store.RedisStore` — real Redis, gated on
     the ``redis`` package being installed.
+  * :class:`~tpu_dpow.store.degraded.DegradedStore` — availability wrapper
+    (``degraded+`` URI prefix): serves from an in-memory fallback while the
+    primary's backend is unreachable, journals writes, reconciles on
+    recovery (the resilience layer's store seam, docs/resilience.md).
 
 Key schema parity (reference dpow_server.py:142,193-205,289,308-319;
 scripts/services.py:97-102):
@@ -289,7 +293,17 @@ class MemoryStore(Store):
 
 def get_store(uri: Optional[str] = None, **kwargs) -> Store:
     """'memory' / None → MemoryStore; 'sqlite:///path' → SqliteStore
-    (durable, stdlib-only); 'redis://...' → RedisStore (if installed)."""
+    (durable, stdlib-only); 'redis://...' → RedisStore (if installed).
+
+    A ``degraded+`` prefix (e.g. ``degraded+redis://host``) wraps the inner
+    store in :class:`~tpu_dpow.store.degraded.DegradedStore`: on connection
+    errors the stack keeps serving from an in-memory fallback, journaling
+    writes and reconciling them when the backend returns.
+    """
+    if uri is not None and uri.startswith("degraded+"):
+        from .degraded import DegradedStore
+
+        return DegradedStore(get_store(uri[len("degraded+"):], **kwargs))
     if uri is None or uri == "memory":
         return MemoryStore(**kwargs)
     if uri.startswith("sqlite://"):
@@ -302,3 +316,7 @@ def get_store(uri: Optional[str] = None, **kwargs) -> Store:
 
         return RedisStore(uri, **kwargs)
     raise ValueError(f"unknown store uri: {uri!r}")
+
+
+# Deferred import: DegradedStore's module imports names defined above.
+from .degraded import DegradedStore  # noqa: E402, F401

@@ -22,7 +22,10 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 from .. import obs
+from ..utils.logging import get_logger
 from . import AuthError, Message, QOS_1, TransportError, User, topic_matches
+
+logger = get_logger("tpu_dpow.transport")
 
 MAX_QUEUE = 10_000
 MAX_OFFLINE_QUEUE = 1_000
@@ -37,6 +40,10 @@ class Session:
     queue: Optional[asyncio.Queue] = None  # None while disconnected
     offline: list = field(default_factory=list)  # queued QoS-1 while offline
     connected_at: float = field(default_factory=time.monotonic)
+    # Has THIS connection already been warned about (one overflow log per
+    # connection, not one per shed message — a wedged consumer at depth
+    # 10k would otherwise emit a log line per publish).
+    overflow_warned: bool = False
 
     def matches(self, topic: str) -> Optional[int]:
         """Highest QoS among matching subscriptions, or None."""
@@ -65,6 +72,14 @@ class Broker:
             "dpow_broker_sessions", "Known sessions (durable ones included)")
         self._m_connected = reg.gauge(
             "dpow_broker_connected_sessions", "Sessions with a live connection")
+        # Queue-full sheds used to vanish into the aggregate "dropped"
+        # count; a single slow client's backlog was indistinguishable from
+        # offline-session QoS-0 drops. Per-client so the wedged one is
+        # nameable (label cardinality is bounded by the registry fold).
+        self._m_queue_full = reg.counter(
+            "dpow_broker_queue_full_drops_total",
+            "Messages shed because a connected client's inbound queue was "
+            "full, by client", ("client",))
 
     def _count(self, event: str, n: int = 1) -> None:
         self.stats[event] += n
@@ -129,6 +144,7 @@ class Broker:
             # 0, the NAT-drop case the pill exists for).
             old_queue.put_nowait(None)
         session.queue = asyncio.Queue(maxsize=MAX_QUEUE)
+        session.overflow_warned = False  # fresh connection, fresh warning
         # Replay QoS-1 messages queued while this session was offline (or
         # salvaged from a taken-over/detached connection), oldest first.
         for msg in session.offline:
@@ -262,10 +278,21 @@ class Broker:
             target.queue.put_nowait(msg)
             self._count("delivered")
         except asyncio.QueueFull:
-            # Shed load: drop the oldest queued message to admit the new one.
+            # Shed load: drop the oldest queued message to admit the new
+            # one. QoS-1 messages shed here break at-least-once for a
+            # CONNECTED-but-wedged client — count it where it can be seen.
             try:
                 target.queue.get_nowait()
             except asyncio.QueueEmpty:
                 pass
             target.queue.put_nowait(msg)
             self._count("dropped")
+            self._m_queue_full.inc(1, target.client_id)
+            if not target.overflow_warned:
+                target.overflow_warned = True
+                logger.warning(
+                    "client %r inbound queue full (%d); shedding oldest "
+                    "messages — reported once per connection, see "
+                    "dpow_broker_queue_full_drops_total for the count",
+                    target.client_id, MAX_QUEUE,
+                )
