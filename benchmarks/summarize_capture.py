@@ -17,7 +17,10 @@ Criteria (anchors: VERDICT.md items 1/2/5, BASELINE.md north stars):
              record carries probe_launches_per_solve, a strict majority of
              probes must solve on their first applied readback
   tests_tpu  rc 0
-  soak       zero errors and zero leaked jobs
+  soak       zero errors, zero leaked jobs, AND the expected outcome mix:
+             ok + aborted + error must account for every op and ok must be
+             ≥ 80% of ops (the workload is 20% deliberate aborts; every
+             normal request must succeed — VERDICT r5 item 6)
   gang_e2e   gang engaged, all requests validate, p50/machinery in-bounds
   yield_drill driver's exact command rc 0 on tpu in <=120 s THROUGH a
              yielding capture, announce flag cleaned up after
@@ -333,11 +336,23 @@ def main() -> int:
     if crash:
         row("soak", False, crash)
     elif r:
-        # soak.py self-gates (rc 1 on error/leak); mirror it so a soak that
-        # recorded a nonzero error or leaked job can never read as PASS.
-        row("soak", r.get("error", 1) == 0 and r.get("leaks", 1) == 0,
-            f"ops {r.get('ops')}, ok {r.get('ok')}, errors {r.get('error')}, "
-            f"leaks {r.get('leaks')}, {r.get('ok_per_sec')}/s")
+        # soak.py self-gates (rc 1 on error/leak); mirror it — AND gate
+        # the outcome MIX explicitly (VERDICT r5 item 6): the workload is
+        # 20% deliberate client aborts (soak.py one_op kind==4) and 80%
+        # normal/raised requests that must ALL succeed, so a PASS needs
+        # ok ≥ 80% of ops and the accounting to close (ok+aborted+error
+        # == ops). The old error/leak-only gate silently tolerated any
+        # ok/aborted split — a stack failing 19% of NORMAL requests as
+        # "aborted" summarized clean.
+        ops = r.get("ops", 0)
+        ok, aborted, errors = r.get("ok", 0), r.get("aborted", 0), r.get("error", 1)
+        accounted = ok + aborted + errors == ops and ops > 0
+        row("soak",
+            errors == 0 and r.get("leaks", 1) == 0 and accounted
+            and ok >= 0.8 * ops,
+            f"ops {ops}, ok {ok}, aborted {aborted}, errors {errors}, "
+            f"leaks {r.get('leaks')}, {r.get('ok_per_sec')}/s"
+            + ("" if accounted else " [MIX UNACCOUNTED]"))
     else:
         row("soak", None, "no fresh record")
 

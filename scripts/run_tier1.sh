@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Tier-1 verify, exactly as ROADMAP.md specifies it — one command instead
+# of a copy-pasted pipeline. Prints DOTS_PASSED (the progress-dot count the
+# driver grades on) and exits with pytest's own return code.
+#
+#   scripts/run_tier1.sh [extra pytest args...]
+#
+# Extra args are appended to the pytest invocation (e.g. `-k sched`).
+set -o pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"

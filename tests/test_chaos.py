@@ -48,6 +48,7 @@ from tpu_dpow.store import MemoryStore
 from tpu_dpow.transport import Message, TransportError
 from tpu_dpow.transport.broker import Broker
 from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.transport.mqtt_codec import parse_work_payload
 from tpu_dpow.utils import nanocrypto as nc
 
 pytestmark = pytest.mark.chaos
@@ -488,6 +489,141 @@ def test_chaos_jax_failures_open_breaker_native_serves_metrics_visible():
                          backend="jax", cause="error") == 3.0
         finally:
             await client.close()
+            await server.close()
+
+    run(main())
+
+
+# ------------------------------------------------- acceptance scenario 3
+
+
+def test_chaos_overload_burst_bounded_window_shed_order_and_recovery():
+    """ISSUE 3 acceptance (chaos flavor): a 12-request burst plus 3
+    precache arrivals against an in-flight window of 4 with a 4-deep fair
+    queue. In-flight must stay bounded, precache must be shed FIRST
+    (never displacing queued on-demand work), the most-slack on-demand
+    overflow must bounce with Busy + Retry-After — and once a worker
+    appears and one fake-clock supervisor grace elapses, the system
+    recovers completely: every admitted request is served with valid
+    work and a fresh request admits instantly."""
+    from tpu_dpow.sched import Busy
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        config = ServerConfig(
+            base_difficulty=EASY, throttle=1000.0, heartbeat_interval=0.05,
+            statistics_interval=3600.0, work_republish_interval=2.0,
+            max_inflight_dispatches=4, admission_queue_limit=4,
+            busy_retry_after=5.0, debug=True,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store, InProcTransport(broker, client_id="server"),
+            clock=clock,
+        )
+        await server.setup()
+        server.start_loops()
+        await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                         "public": "N", "precache": "0",
+                                         "ondemand": "0"})
+        await store.sadd("services", "svc")
+
+        def request(h, timeout):
+            return asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": timeout}
+            ))
+
+        worker_transport = InProcTransport(broker, client_id="worker")
+        seen_inflight = []
+
+        async def start_worker():
+            await worker_transport.connect()
+            await worker_transport.subscribe("work/#")
+
+            async def loop():
+                async for msg in worker_transport.messages():
+                    if not msg.topic.startswith("work/"):
+                        continue
+                    # the window bound must hold at every dispatch the
+                    # worker ever observes
+                    seen_inflight.append(len(server.work_futures))
+                    bh, diff_hex, _tid = parse_work_payload(msg.payload)
+                    work = solve(bh, int(diff_hex, 16))
+                    work_type = msg.topic.split("/", 1)[1]
+                    await worker_transport.publish(
+                        f"result/{work_type}", f"{bh},{work},{PAYOUT_1}"
+                    )
+
+            return asyncio.ensure_future(loop())
+
+        worker_task = None
+        try:
+            # burst: 8 tight-deadline requests (4 granted + 4 queued),
+            # then 4 with MORE slack — the shed policy's chosen victims.
+            tight = [request(random_hash(), 10) for _ in range(8)]
+            await settle()
+            assert len(server.work_futures) == 4  # bounded in-flight
+            assert server.admission.window.inflight == 4
+            assert server.admission.window.queued == 4
+            slack = [request(random_hash(), 20) for _ in range(4)]
+            await settle()
+            refused = [t for t in slack if t.done()]
+            assert len(refused) == 4  # every most-slack arrival bounced
+            for t in refused:
+                with pytest.raises(Busy) as e:
+                    t.result()
+                assert e.value.retry_after == pytest.approx(5.0)
+            assert all(not t.done() for t in tight)  # admitted work survives
+
+            # precache arrivals against the saturated window: shed first,
+            # and the on-demand queue is untouched by them.
+            for i in range(3):
+                await server.block_arrival_handler(
+                    random_hash(), nc.encode_account(bytes([i]) * 32), None
+                )
+            assert server.admission.window.queued == 4
+            snap = obs.snapshot()
+            shed = snap["dpow_sched_shed_total"]["series"]
+            assert sum(v for k, v in shed.items()
+                       if k.startswith("precache")) == 3
+            assert sum(v for k, v in shed.items()
+                       if k.startswith("ondemand")) == 0  # rejected, not shed
+
+            # RECOVERY: a worker joins; the supervisor grace re-publishes
+            # the 4 dispatches that fired into an empty swarm; each solve
+            # releases a slot which grants the next queued ticket.
+            worker_task = await start_worker()
+            for _ in range(20):
+                await clock.advance(2.0)
+                await settle()
+                if all(t.done() for t in tight):
+                    break
+            for t in tight:
+                resp = t.result()
+                nc.validate_work(resp["hash"], resp["work"], EASY)
+            assert seen_inflight and max(seen_inflight) <= 4
+
+            # drained: the window is empty and a fresh request admits
+            # immediately, no Busy, no queue wait.
+            assert server.admission.window.inflight == 0
+            assert server.admission.window.queued == 0
+            h = random_hash()
+            resp = await asyncio.wait_for(request(h, 10), 5)
+            nc.validate_work(h, resp["work"], EASY)
+
+            snap = obs.snapshot()
+            admitted = snap["dpow_sched_admitted_total"]["series"]
+            rejected = snap["dpow_sched_rejected_total"]["series"]
+            assert sum(admitted.values()) == 9   # 8 burst + 1 recovery
+            assert sum(rejected.values()) == 4   # the slack arrivals
+        finally:
+            if worker_task is not None:
+                worker_task.cancel()
+                await asyncio.gather(worker_task, return_exceptions=True)
+            await worker_transport.close()
             await server.close()
 
     run(main())

@@ -354,7 +354,18 @@ def test_e2e_over_mqtt_wire():
                     body = await resp.json()
             assert "work" in body, body
             nc.validate_work(h, body["work"], EASY_BASE)
-            credited = await store.hget(f"client:{PAYOUT_2}", "ondemand")
+            # Crediting is deliberately ASYNC after the response: the
+            # result handler resolves the waiter's future first, then
+            # fans out the QoS-1 cancel (a real PUBACK round trip on this
+            # wire) and only then runs the crediting gather — so the HTTP
+            # reply routinely lands before the hincrby does. Await the
+            # eventual credit instead of racing it.
+            credited = None
+            for _ in range(100):
+                credited = await store.hget(f"client:{PAYOUT_2}", "ondemand")
+                if credited is not None:
+                    break
+                await asyncio.sleep(0.05)
             assert int(credited or 0) == 1
         finally:
             await client.close()

@@ -56,7 +56,13 @@ def run(coro):
 mosquitto_bin = shutil.which("mosquitto")
 
 
-@pytest.mark.skipif(mosquitto_bin is None, reason="mosquitto not installed")
+@pytest.mark.skipif(
+    mosquitto_bin is None,
+    reason="stock Mosquitto never executed in this image: the mosquitto "
+    "binary is not installed, so broker interop rests on the byte-level "
+    "wire goldens in tests/test_mqtt.py until a deployment host runs this "
+    "(VERDICT r5 item 7; liability noted in docs/parity.md)",
+)
 def test_mqtt_transport_against_stock_mosquitto(tmp_path):
     """Connect, subscribe (QoS 1), publish QoS 0 and QoS 1, receive both —
     through an actual Mosquitto broker, not our own."""
@@ -120,7 +126,11 @@ except ImportError:
 
 @pytest.mark.skipif(
     redis_bin is None or not redis_pkg,
-    reason="redis-server binary or redis package not installed",
+    reason="stock Redis never executed in this image: the redis-server "
+    "binary and/or redis package are not installed, so RedisStore parity "
+    "rests on the contract suite over the in-process fake "
+    "(tests/test_store_contract.py) until a deployment host runs this "
+    "(VERDICT r5 item 7; liability noted in docs/parity.md)",
 )
 def test_redis_store_against_real_redis(tmp_path):
     """The Store ops the server actually leans on — setnx winner lock with

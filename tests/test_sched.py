@@ -1,0 +1,472 @@
+"""sched/ unit contracts: quota ledger, fair priority queue, dispatch window.
+
+Every timer runs on FakeClock — refills, leases and queue deadlines are
+advanced explicitly, never slept for. The full-stack overload scenarios
+live in tests/test_sched_overload.py (HTTP/WS faces) and tests/test_chaos.py
+(burst + recovery); these pin each primitive's semantics in isolation.
+"""
+
+import asyncio
+
+import pytest
+
+from tpu_dpow.resilience import FakeClock
+from tpu_dpow.sched import (
+    AdmissionController,
+    Busy,
+    DispatchWindow,
+    FairQueue,
+    QuotaLedger,
+    Ticket,
+)
+from tpu_dpow.store import MemoryStore
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+# ---------------------------------------------------------------------------
+# QuotaLedger
+# ---------------------------------------------------------------------------
+
+
+def test_quota_bucket_drains_and_refills_on_fake_clock():
+    async def main():
+        clock = FakeClock()
+        ledger = QuotaLedger(MemoryStore(), rate=2.0, burst=4.0, clock=clock)
+        for _ in range(4):
+            assert (await ledger.consume("svc")).allowed
+        verdict = await ledger.consume("svc")
+        assert not verdict.allowed
+        assert verdict.retry_after == pytest.approx(0.5)  # 1 token / 2 per s
+        await clock.advance(0.5)
+        assert (await ledger.consume("svc")).allowed
+        # refill caps at burst, not beyond
+        await clock.advance(1000.0)
+        assert await ledger.peek("svc") == pytest.approx(4.0)
+
+    run(main())
+
+
+def test_quota_buckets_are_per_service():
+    async def main():
+        clock = FakeClock()
+        ledger = QuotaLedger(MemoryStore(), rate=1.0, burst=1.0, clock=clock)
+        assert (await ledger.consume("a")).allowed
+        assert not (await ledger.consume("a")).allowed
+        assert (await ledger.consume("b")).allowed  # b's bucket untouched
+
+    run(main())
+
+
+def test_quota_rate_zero_is_unmetered_and_storeless():
+    async def main():
+        class ExplodingStore(MemoryStore):
+            async def hgetall(self, key):
+                raise AssertionError("rate 0 must not touch the store")
+
+            async def hset(self, key, mapping):
+                raise AssertionError("rate 0 must not touch the store")
+
+        ledger = QuotaLedger(ExplodingStore(), rate=0.0, burst=1.0,
+                             clock=FakeClock())
+        assert (await ledger.consume("svc")).allowed
+
+    run(main())
+
+
+def test_quota_state_persists_across_ledger_restart():
+    """The store-backed half: a new ledger instance over the SAME store
+    (a server restart) resumes the drained bucket, no free burst."""
+
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        ledger = QuotaLedger(store, rate=1.0, burst=5.0, clock=clock)
+        for _ in range(5):
+            assert (await ledger.consume("svc")).allowed
+        assert not (await ledger.consume("svc")).allowed
+
+        reborn = QuotaLedger(store, rate=1.0, burst=5.0, clock=clock)
+        assert not (await reborn.consume("svc")).allowed
+        await clock.advance(1.0)
+        assert (await reborn.consume("svc")).allowed
+
+    run(main())
+
+
+def test_quota_clock_restart_keeps_tokens_no_refund():
+    """A monotonic-clock reset (restart) must not mint tokens: a stamp
+    from the future anchors refill at 'now' and keeps the balance."""
+
+    async def main():
+        store = MemoryStore()
+        late = FakeClock(start=1000.0)
+        ledger = QuotaLedger(store, rate=1.0, burst=5.0, clock=late)
+        for _ in range(5):
+            await ledger.consume("svc")
+        # restart: fresh process, monotonic clock back near zero
+        early = FakeClock(start=0.0)
+        reborn = QuotaLedger(store, rate=1.0, burst=5.0, clock=early)
+        assert await reborn.peek("svc") == pytest.approx(0.0)
+        assert not (await reborn.consume("svc")).allowed
+        await early.advance(2.0)
+        assert (await reborn.consume("svc")).allowed
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# FairQueue
+# ---------------------------------------------------------------------------
+
+
+def t(key, svc, *, wc="ondemand", diff=0, deadline=100.0, oq=False):
+    return Ticket(key, svc, work_class=wc, difficulty=diff,
+                  deadline=deadline, over_quota=oq)
+
+
+def test_queue_class_dominates_then_round_robin_across_services():
+    q = FairQueue()
+    q.push(t("p1", "node", wc="precache"))
+    q.push(t("a1", "a"))
+    q.push(t("a2", "a"))
+    q.push(t("a3", "a"))
+    q.push(t("b1", "b"))
+    # on-demand drains before ANY precache; a's 3 queued entries cannot
+    # starve b — grants alternate while both hold work.
+    order = [q.pop_best().key for _ in range(5)]
+    assert order[:4] in (["a1", "b1", "a2", "a3"], ["b1", "a1", "a2", "a3"])
+    assert order[4] == "p1"
+    assert q.pop_best() is None
+
+
+def test_queue_within_service_least_slack_then_hardest():
+    q = FairQueue()
+    q.push(t("loose", "a", deadline=50.0))
+    q.push(t("tight", "a", deadline=10.0))
+    q.push(t("tight_hard", "a", deadline=10.0, diff=999))
+    assert [q.pop_best().key for _ in range(3)] == [
+        "tight_hard", "tight", "loose"]
+
+
+def test_queue_over_quota_yields_to_in_quota():
+    q = FairQueue()
+    q.push(t("oq", "noisy", oq=True, deadline=1.0))  # urgent but over quota
+    q.push(t("ok", "quiet", deadline=99.0))
+    assert q.pop_best().key == "ok"
+    assert q.pop_best().key == "oq"
+
+
+def test_shed_victim_policy_order():
+    """precache → over-quota → most slack, regardless of insert order."""
+    q = FairQueue()
+    q.push(t("od_tight", "a", deadline=5.0))
+    q.push(t("od_loose", "b", deadline=500.0))
+    q.push(t("oq", "c", oq=True, deadline=1.0))
+    q.push(t("pre", "node", wc="precache"))
+    assert q.shed_victim().key == "pre"
+    assert q.shed_victim().key == "oq"
+    assert q.shed_victim().key == "od_loose"  # most slack sheds first
+    assert q.shed_victim().key == "od_tight"
+    assert q.shed_victim() is None
+
+
+def test_queue_expired_removes_past_deadline():
+    q = FairQueue()
+    q.push(t("dead", "a", deadline=1.0))
+    q.push(t("alive", "a", deadline=10.0))
+    gone = q.expired(now=5.0)
+    assert [x.key for x in gone] == ["dead"]
+    assert len(q) == 1
+
+
+# ---------------------------------------------------------------------------
+# DispatchWindow
+# ---------------------------------------------------------------------------
+
+
+def make_window(clock, capacity=2, queue_limit=2, lease=30.0):
+    events = []
+    w = DispatchWindow(capacity=capacity, queue_limit=queue_limit,
+                       clock=clock, lease=lease, retry_after=3.0,
+                       on_event=lambda e, tk: events.append((e, tk.key)))
+    return w, events
+
+
+def test_window_grants_until_capacity_then_queues_then_sheds():
+    async def main():
+        clock = FakeClock()
+        w, events = make_window(clock, capacity=2, queue_limit=1)
+        await w.acquire(t("h1", "a"))
+        await w.acquire(t("h2", "a"))
+        assert w.inflight == 2
+        # third waits in the queue
+        waiting = asyncio.ensure_future(w.acquire(t("h3", "a", deadline=1e9)))
+        await asyncio.sleep(0)
+        assert w.queued == 1 and not waiting.done()
+        # fourth overflows the queue: IT is the policy-worst (most slack)
+        with pytest.raises(Busy) as e:
+            await w.acquire(t("h4", "a", deadline=2e9))
+        assert e.value.retry_after == pytest.approx(3.0)
+        assert ("rejected", "h4") in events
+        # release → the queued waiter is granted
+        w.release(next(iter(w._inflight)))
+        await asyncio.sleep(0)
+        assert waiting.done() and w.inflight == 2
+        assert ("admitted", "h3") in events
+
+    run(main())
+
+
+def test_window_shed_prefers_precache_then_most_slack():
+    async def main():
+        clock = FakeClock()
+        w, events = make_window(clock, capacity=1, queue_limit=1)
+        await w.acquire(t("busy", "a"))
+        # precache never queues behind a full window: shed on arrival
+        assert w.try_acquire(t("pre", "node", wc="precache")) is False
+        assert ("shed", "pre") in events
+        # a queued loose waiter is shed when a tighter one arrives
+        loose = asyncio.ensure_future(w.acquire(t("loose", "a", deadline=900.0)))
+        await asyncio.sleep(0)
+        tight = asyncio.ensure_future(w.acquire(t("tight", "b", deadline=10.0)))
+        await asyncio.sleep(0)
+        with pytest.raises(Busy):
+            await loose
+        assert ("shed", "loose") in events
+        w.release(next(iter(w._inflight)))
+        await tight  # the urgent one survived and got the slot
+
+    run(main())
+
+
+def test_window_unbounded_capacity_never_blocks():
+    async def main():
+        clock = FakeClock()
+        w, events = make_window(clock, capacity=0, queue_limit=0)
+        for i in range(64):
+            await w.acquire(t(f"h{i}", "a"))
+        assert w.inflight == 64 and w.queued == 0
+        assert all(e == "admitted" for e, _ in events)
+
+    run(main())
+
+
+def test_window_precache_lease_lapses_on_clock():
+    async def main():
+        clock = FakeClock()
+        w, events = make_window(clock, capacity=1, lease=30.0)
+        pre = t("pre", "node", wc="precache")
+        assert w.try_acquire(pre) is True
+        assert w.inflight == 1
+        # a queued on-demand waiter is unblocked when the lease lapses
+        od = asyncio.ensure_future(w.acquire(t("od", "a", deadline=1e9)))
+        await asyncio.sleep(0)
+        assert not od.done()
+        w.expire(clock.time() + 31.0)
+        await asyncio.sleep(0)
+        await od
+        assert w.inflight == 1 and pre not in w._inflight
+
+    run(main())
+
+
+def test_window_queue_deadline_expiry_fails_with_busy():
+    async def main():
+        clock = FakeClock()
+        w, events = make_window(clock, capacity=1, queue_limit=4)
+        await w.acquire(t("busy", "a"))
+        waiter = asyncio.ensure_future(w.acquire(t("late", "a", deadline=5.0)))
+        await asyncio.sleep(0)
+        w.expire(now=6.0)
+        with pytest.raises(Busy):
+            await waiter
+        assert ("shed", "late") in events
+
+    run(main())
+
+
+def test_window_cancelled_waiter_leaves_no_debris():
+    async def main():
+        clock = FakeClock()
+        w, _ = make_window(clock, capacity=1, queue_limit=4)
+        held = t("held", "a")
+        await w.acquire(held)
+        waiter = asyncio.ensure_future(w.acquire(t("gone", "a", deadline=1e9)))
+        await asyncio.sleep(0)
+        waiter.cancel()
+        await asyncio.gather(waiter, return_exceptions=True)
+        assert w.queued == 0
+        # the slot still cycles normally afterwards
+        w.release(held)
+        nxt = await w.acquire(t("next", "a"))
+        assert nxt in w._inflight
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController (facade + metrics accounting)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_decisions_are_exhaustive_and_disjoint():
+    """Every admission ends in exactly one of admitted/rejected/shed, so
+    the three families sum to the offered load."""
+
+    async def main():
+        from tpu_dpow import obs
+
+        obs.reset()
+        clock = FakeClock()
+        ctl = AdmissionController(
+            MemoryStore(), clock=clock, window=2, queue_limit=1,
+            busy_retry_after=2.0,
+        )
+        granted = []
+        offered = 0
+        # 2 grants, 1 queued, 1 rejected, 2 precache sheds = 6 offered
+        for i in range(2):
+            offered += 1
+            granted.append(await ctl.acquire_dispatch(
+                f"h{i}", "svc", difficulty=1, deadline=1e9))
+        offered += 1
+        queued = asyncio.ensure_future(ctl.acquire_dispatch(
+            "h2", "svc", difficulty=1, deadline=1e9))
+        await asyncio.sleep(0)
+        offered += 1
+        with pytest.raises(Busy):
+            await ctl.acquire_dispatch("h3", "svc", difficulty=1, deadline=2e9)
+        for i in range(2):
+            offered += 1
+            assert ctl.try_acquire_precache(f"p{i}") is None
+        ctl.release(granted[0])
+        await queued
+
+        snap = obs.snapshot()
+
+        def total(name):
+            return sum(snap[name]["series"].values()) if name in snap else 0
+
+        admitted = total("dpow_sched_admitted_total")
+        rejected = total("dpow_sched_rejected_total")
+        shed = total("dpow_sched_shed_total")
+        assert admitted == 3 and rejected == 1 and shed == 2
+        assert admitted + rejected + shed == offered
+        assert snap["dpow_sched_inflight"]["series"][""] == 2.0
+
+    run(main())
+
+
+def test_admission_hard_quota_rejects_with_refill_retry_after():
+    async def main():
+        clock = FakeClock()
+        ctl = AdmissionController(
+            MemoryStore(), clock=clock, window=0, quota_rate=1.0,
+            quota_burst=1.0, quota_hard=True,
+        )
+        assert await ctl.consume_quota("svc") is False
+        with pytest.raises(Busy) as e:
+            await ctl.consume_quota("svc")
+        assert e.value.retry_after == pytest.approx(1.0)
+        await clock.advance(1.0)
+        assert await ctl.consume_quota("svc") is False
+
+    run(main())
+
+
+def test_admission_soft_quota_flags_but_serves():
+    async def main():
+        clock = FakeClock()
+        ctl = AdmissionController(
+            MemoryStore(), clock=clock, window=0, quota_rate=1.0,
+            quota_burst=1.0, quota_hard=False,
+        )
+        assert await ctl.consume_quota("svc") is False
+        assert await ctl.consume_quota("svc") is True  # over quota, not refused
+        tk = await ctl.acquire_dispatch(
+            "h", "svc", difficulty=1, deadline=1e9, over_quota=True)
+        assert tk.over_quota
+
+    run(main())
+
+
+def test_admission_release_key_frees_precache_lease():
+    async def main():
+        clock = FakeClock()
+        ctl = AdmissionController(MemoryStore(), clock=clock, window=1,
+                                  queue_limit=2)
+        assert ctl.try_acquire_precache("HASH") is not None
+        assert ctl.window.inflight == 1
+        ctl.release_key("HASH")  # the worker result landed
+        assert ctl.window.inflight == 0
+        ctl.release_key("HASH")  # idempotent
+        assert ctl.window.inflight == 0
+
+    run(main())
+
+
+def test_admission_poll_loop_runs_on_injected_clock():
+    async def main():
+        clock = FakeClock()
+        ctl = AdmissionController(MemoryStore(), clock=clock, window=1,
+                                  queue_limit=2, precache_lease=10.0)
+        assert ctl.try_acquire_precache("HASH") is not None
+        task = asyncio.ensure_future(ctl.run(interval=1.0))
+        await asyncio.sleep(0)
+        await clock.advance(11.0)  # lease lapses via the poll loop
+        assert ctl.window.inflight == 0
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+def test_release_of_ondemand_ticket_does_not_orphan_precache_lease():
+    """Review regression: an on-demand dispatch and a precache lease can
+    coexist for the SAME hash (a service requests a block whose precache
+    is still pending). Releasing the dispatch ticket must leave the lease
+    addressable, so the worker result (release_key) still frees its slot
+    instead of pinning the window shut until the lease lapses."""
+
+    async def main():
+        clock = FakeClock()
+        ctl = AdmissionController(MemoryStore(), clock=clock, window=4,
+                                  queue_limit=2)
+        lease = ctl.try_acquire_precache("HASH")
+        assert lease is not None
+        od = await ctl.acquire_dispatch("HASH", "svc", difficulty=1,
+                                        deadline=1e9)
+        assert ctl.window.inflight == 2
+        ctl.release(od)  # the dispatch tears down first
+        assert ctl.window.inflight == 1  # the lease still holds ITS slot
+        ctl.release_key("HASH")  # the precache result lands
+        assert ctl.window.inflight == 0
+
+    run(main())
+
+
+def test_duplicate_precache_admission_is_idempotent_per_hash():
+    """Review regression: a replayed block confirmation (node ws reconnect
+    re-delivering) must not grant a SECOND window slot for the same hash —
+    the overwritten lease would strand the first slot until its lapse.
+    The live lease is returned as-is; once it is released, a fresh
+    admission for the hash grants normally."""
+
+    async def main():
+        clock = FakeClock()
+        ctl = AdmissionController(MemoryStore(), clock=clock, window=4,
+                                  queue_limit=2)
+        first = ctl.try_acquire_precache("HASH")
+        again = ctl.try_acquire_precache("HASH")
+        assert again is first
+        assert ctl.window.inflight == 1  # one slot, not two
+        ctl.release_key("HASH")  # the worker result frees everything
+        assert ctl.window.inflight == 0
+        fresh = ctl.try_acquire_precache("HASH")
+        assert fresh is not None and fresh is not first
+        assert ctl.window.inflight == 1
+
+    run(main())

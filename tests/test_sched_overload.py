@@ -1,0 +1,382 @@
+"""Overload acceptance: the ISSUE-3 scenario through the real HTTP/WS stack.
+
+A burst of 50 admission decisions — 39 HTTP POSTs + 1 websocket request
+from 3 services, plus 10 precache block arrivals — against an in-flight
+window of 8 with a 10-deep fair queue must yield:
+
+  * bounded concurrent dispatches (never more than 8 holding slots),
+  * 429 responses carrying Retry-After (and a structured ``busy`` frame
+    on the websocket face),
+  * precache shed before any on-demand work,
+  * no service starved: each admitted at least its fair share of the
+    window+queue capacity,
+  * /metrics admitted + rejected + shed summing to exactly 50,
+  * full recovery: once a worker appears and the supervisor's fake-clock
+    grace elapses, every admitted request completes with valid work.
+
+All scheduling time runs on FakeClock (supervisor grace, admission poll,
+quota refill); the only real-time waits are event-loop settles and the
+HTTP round trips themselves.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from tests.test_server import ACCOUNT, EASY_BASE, random_hash, solve
+from tpu_dpow import obs
+from tpu_dpow.resilience import FakeClock
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.server.api import ServerRunner
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.transport.mqtt_codec import parse_work_payload
+from tpu_dpow.utils import nanocrypto as nc
+
+WINDOW = 8
+QUEUE = 10
+SERVICES = ("svc-a", "svc-b", "svc-c")
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+class OverloadHarness:
+    """Server + HTTP/WS faces with a bounded admission window, FakeClock."""
+
+    def __init__(self, **overrides):
+        self.clock = FakeClock()
+        settings = dict(
+            base_difficulty=EASY_BASE,
+            throttle=100000.0,
+            heartbeat_interval=3600.0,
+            statistics_interval=3600.0,
+            service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+            max_inflight_dispatches=WINDOW,
+            admission_queue_limit=QUEUE,
+            busy_retry_after=7.0,
+            debug=True,  # precache every observed block
+        )
+        settings.update(overrides)
+        self.config = ServerConfig(**settings)
+        self.broker = Broker()
+        self.store = MemoryStore()
+        self.transport = InProcTransport(self.broker, client_id="server")
+        self.server = DpowServer(
+            self.config, self.store, self.transport, clock=self.clock
+        )
+        self.worker_task = None
+        self.max_inflight_seen = 0
+
+        # Sample the dispatch population at every publish: the window
+        # bound must hold at the exact moments work leaves the server.
+        real_publish = self.transport.publish
+
+        async def sampling_publish(topic, payload, qos=0):
+            self._sample()
+            return await real_publish(topic, payload, qos=qos)
+
+        self.transport.publish = sampling_publish
+
+    def _sample(self):
+        self.max_inflight_seen = max(
+            self.max_inflight_seen,
+            len(self.server.work_futures),
+            self.server.admission.window.inflight,
+        )
+
+    async def __aenter__(self):
+        self.runner = ServerRunner(self.server, self.config)
+        await self.runner.start()
+        for svc in SERVICES:
+            await self.store.hset(
+                f"service:{svc}",
+                {"api_key": hash_key("secret"), "public": "N",
+                 "display": svc, "website": "", "precache": "0", "ondemand": "0"},
+            )
+            await self.store.sadd("services", svc)
+        self.http = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc):
+        if self.worker_task:
+            self.worker_task.cancel()
+        await self.http.close()
+        await self.runner.stop()
+
+    def url(self, app, path):
+        return f"http://127.0.0.1:{self.runner.ports[app]}{path}"
+
+    async def start_worker(self):
+        t = InProcTransport(self.broker, client_id="worker")
+        await t.connect()
+        await t.subscribe("work/#")
+        await t.subscribe("cancel/#", qos=1)
+
+        async def loop():
+            async for msg in t.messages():
+                if msg.topic.startswith("work/"):
+                    bh, diff_hex, _tid = parse_work_payload(msg.payload)
+                    work = solve(bh, int(diff_hex, 16))
+                    work_type = msg.topic.split("/", 1)[1]
+                    await t.publish(f"result/{work_type}", f"{bh},{work},{ACCOUNT}")
+
+        self.worker_task = asyncio.ensure_future(loop())
+        return t
+
+
+async def wait_until(cond, timeout=20.0):
+    t0 = asyncio.get_running_loop().time()
+    while not cond():
+        if asyncio.get_running_loop().time() - t0 > timeout:
+            raise AssertionError("condition not reached")
+        await asyncio.sleep(0.01)
+
+
+def sched_totals(snapshot):
+    out = {}
+    for name in ("dpow_sched_admitted_total", "dpow_sched_rejected_total",
+                 "dpow_sched_shed_total"):
+        fam = snapshot.get(name, {"series": {}})
+        out[name] = sum(fam["series"].values())
+    return out
+
+
+def test_overload_burst_bounded_window_shed_order_fairness_and_metrics():
+    obs.reset()
+
+    async def main():
+        async with OverloadHarness() as hx:
+            url = hx.url("service", "/service/")
+            demands = {"svc-a": 14, "svc-b": 13, "svc-c": 12}  # +1 WS = 40
+
+            async def post(svc):
+                async with hx.http.post(url, json={
+                    "user": svc, "api_key": "secret", "hash": random_hash(),
+                    "timeout": 20,
+                }) as resp:
+                    return svc, resp.status, dict(resp.headers), await resp.json()
+
+            # Interleaved burst: round-robin across the three services,
+            # the way concurrent tenants actually arrive.
+            order = []
+            pools = {s: n for s, n in demands.items()}
+            while any(pools.values()):
+                for svc in SERVICES:
+                    if pools[svc]:
+                        pools[svc] -= 1
+                        order.append(svc)
+            tasks = [asyncio.ensure_future(post(svc)) for svc in order]
+            # Let the burst pour in: window fills (8), queue fills (10),
+            # the rest bounce with 429.
+            await wait_until(
+                lambda: sum(t.done() for t in tasks) >= len(order) - WINDOW - QUEUE
+            )
+            assert len(hx.server.work_futures) == WINDOW
+            assert hx.server.admission.window.inflight == WINDOW
+            assert hx.server.admission.window.queued == QUEUE
+
+            # The 50th decision, via the websocket face: a long-timeout
+            # request is the most-slack entry — the policy victim — and
+            # must come back as a structured busy frame, not a hang.
+            async with hx.http.ws_connect(hx.url("service_ws", "/service_ws/")) as ws:
+                await ws.send_json({"user": "svc-c", "api_key": "secret",
+                                    "hash": random_hash(), "timeout": 30,
+                                    "id": "ws-probe"})
+                frame = json.loads((await ws.receive()).data)
+            assert frame["busy"] is True and frame["id"] == "ws-probe"
+            assert frame["retry_after"] >= 1
+
+            # 10 precache block arrivals against the full window: ALL shed
+            # (precache never displaces queued on-demand work).
+            for _ in range(10):
+                await hx.server.block_arrival_handler(
+                    random_hash(), nc.encode_account(bytes(range(32))), None
+                )
+            snap = obs.snapshot()
+            pre_shed = snap["dpow_sched_shed_total"]["series"]
+            assert sum(v for k, v in pre_shed.items()
+                       if k.startswith("precache")) == 10
+            # ...and no on-demand work was displaced by them.
+            assert hx.server.admission.window.queued == QUEUE
+
+            # Every refused POST carried the 429 contract.
+            refused = [r for t in tasks if t.done() and not t.cancelled()
+                       for r in [t.result()] if r[1] == 429]
+            assert len(refused) == len(order) - WINDOW - QUEUE
+            for _svc, status, headers, body in refused:
+                assert status == 429
+                assert headers["Retry-After"] == str(body["retry_after"])
+                assert body["busy"] is True and "error" in body
+
+            # RECOVERY: a worker joins; the supervisor's fake-clock grace
+            # re-publishes the 8 dispatches whose original publishes fired
+            # into an empty swarm, and the drain cascades through the
+            # queue (each release grants the next fair-share ticket).
+            await hx.start_worker()
+            for _ in range(40):
+                await hx.clock.advance(3.0)  # supervisor grace is 2 s
+                if all(t.done() for t in tasks):
+                    break
+                await asyncio.sleep(0.05)
+            results = [t.result() for t in tasks]
+            served = [r for r in results if r[1] == 200 and "work" in r[3]]
+            assert len(served) == WINDOW + QUEUE
+            for _svc, _status, _headers, body in served:
+                nc.validate_work(body["hash"], body["work"], EASY_BASE)
+
+            # Bounded concurrency held through the whole drain.
+            assert hx.max_inflight_seen <= WINDOW
+
+            # FAIRNESS: no tenant starved — every service got at least its
+            # fair share of the admitted capacity.
+            fair_share = (WINDOW + QUEUE) // len(SERVICES)
+            per_service = {s: 0 for s in SERVICES}
+            for svc, status, _h, body in results:
+                if status == 200 and "work" in body:
+                    per_service[svc] += 1
+            assert all(n >= fair_share for n in per_service.values()), per_service
+
+            # /metrics: admitted + rejected + shed account for all 50
+            # decisions, exactly once each.
+            async with hx.http.get(hx.url("upcheck", "/metrics")) as resp:
+                page = await resp.text()
+            families = obs.parse_text(page)
+            totals = {
+                name: sum(value for _labels, value in families.get(name, []))
+                for name in ("dpow_sched_admitted_total",
+                             "dpow_sched_rejected_total",
+                             "dpow_sched_shed_total")
+            }
+            assert sum(totals.values()) == 50, totals
+            assert totals["dpow_sched_admitted_total"] == WINDOW + QUEUE
+
+    run(main())
+
+
+def test_hard_quota_429_with_refill_retry_after_over_http():
+    """quota_hard: an over-quota tenant is refused at the door with the
+    bucket's own refill time as Retry-After — no window interaction."""
+    obs.reset()
+
+    async def main():
+        async with OverloadHarness(
+            max_inflight_dispatches=0, quota_rate=0.5, quota_burst=2.0,
+            quota_hard=True,
+        ) as hx:
+            await hx.start_worker()
+            url = hx.url("service", "/service/")
+
+            async def post(svc):
+                async with hx.http.post(url, json={
+                    "user": svc, "api_key": "secret", "hash": random_hash(),
+                    "timeout": 20,
+                }) as resp:
+                    return resp.status, dict(resp.headers), await resp.json()
+
+            # burst of 2 allowed; 3rd refused with the refill hint
+            assert (await post("svc-a"))[0] == 200
+            assert (await post("svc-a"))[0] == 200
+            status, headers, body = await post("svc-a")
+            assert status == 429 and body["busy"] is True
+            assert int(headers["Retry-After"]) == 2  # 1 token / 0.5 per s
+            # another tenant is untouched by the noisy one's quota
+            assert (await post("svc-b"))[0] == 200
+            # refill on the injected clock re-admits the noisy tenant
+            await hx.clock.advance(2.0)
+            assert (await post("svc-a"))[0] == 200
+
+    run(main())
+
+
+def test_quota_ledger_survives_server_restart_on_durable_store(tmp_path):
+    """The store-backed half end-to-end: a drained bucket on a sqlite
+    store is still drained after a full server restart over the same
+    file (the reference's Throttler forgets everything it ever knew)."""
+    obs.reset()
+
+    async def main():
+        from tpu_dpow.store import get_store
+
+        db = str(tmp_path / "quota.db")
+
+        async def boot():
+            hx = OverloadHarness(
+                max_inflight_dispatches=0, quota_rate=0.1, quota_burst=2.0,
+                quota_hard=True,
+            )
+            hx.store = get_store(f"sqlite://{db}")
+            hx.server = DpowServer(hx.config, hx.store, hx.transport,
+                                   clock=hx.clock)
+            return hx
+
+        hx = await boot()
+        async with hx:
+            await hx.start_worker()
+            url = hx.url("service", "/service/")
+            for _ in range(2):
+                async with hx.http.post(url, json={
+                    "user": "svc-a", "api_key": "secret",
+                    "hash": random_hash(), "timeout": 20,
+                }) as resp:
+                    assert resp.status == 200
+
+        hx2 = await boot()
+        async with hx2:
+            url = hx2.url("service", "/service/")
+            async with hx2.http.post(url, json={
+                "user": "svc-a", "api_key": "secret",
+                "hash": random_hash(), "timeout": 20,
+            }) as resp:
+                assert resp.status == 429  # the drained bucket survived
+
+    run(main())
+
+
+def test_queue_wait_comes_out_of_the_request_budget():
+    """Review regression: time spent waiting for a window slot must be
+    deducted from the request's own timeout — a queued request granted
+    late keeps its ORIGINAL deadline (supervisor + wait budget), it does
+    not get a fresh full timeout on top of the queue wait."""
+    obs.reset()
+
+    async def main():
+        from tests.test_server import solve as solve_work
+
+        hx = OverloadHarness(max_inflight_dispatches=1,
+                             admission_queue_limit=2)
+        runner = ServerRunner(hx.server, hx.config)
+        await runner.start()
+        try:
+            h1, h2 = random_hash(), random_hash()
+            await hx.store.set(f"block:{h1}", "0")
+            await hx.store.set(f"block:{h2}", "0")
+            task_a = asyncio.ensure_future(
+                hx.server._dispatch_ondemand(h1, None, EASY_BASE, 5.0))
+            await asyncio.sleep(0.05)  # A holds the only slot
+            task_b = asyncio.ensure_future(
+                hx.server._dispatch_ondemand(h2, None, EASY_BASE, 5.0))
+            await asyncio.sleep(0.05)
+            assert hx.server.admission.window.queued == 1
+
+            # 2 fake seconds of queue wait, then A resolves and B is
+            # granted with only its REMAINING 3 s of budget.
+            await hx.clock.advance(2.0)
+            await hx.server.client_result_handler(
+                "result/ondemand", f"{h1},{solve_work(h1, EASY_BASE)},{ACCOUNT}")
+            await task_a
+            await asyncio.sleep(0.1)  # B's grant + dispatch settle
+            assert h2 in hx.server.supervisor._dispatches
+            # deadline is the ORIGINAL t0+5, not grant-time+5 (= 7.0)
+            assert hx.server.supervisor._dispatches[h2].deadline == \
+                pytest.approx(5.0)
+            task_b.cancel()
+            await asyncio.gather(task_b, return_exceptions=True)
+        finally:
+            await runner.stop()
+
+    run(main())

@@ -278,7 +278,7 @@ def test_gang_e2e_gates_on_engagement_and_bounds(tmp_path):
 
 
 def test_soak_gates_on_errors_and_leaks(tmp_path):
-    rec = {"rc": 0, "result": {"ops": 160, "ok": 160, "error": 0,
+    rec = {"rc": 0, "result": {"ops": 160, "ok": 160, "aborted": 0, "error": 0,
                                "leaks": 0, "ok_per_sec": 18.0}}
     _, rows = summarize(tmp_path, {"soak": rec})
     assert rows["soak"][0] == "PASS"
@@ -289,6 +289,29 @@ def test_soak_gates_on_errors_and_leaks(tmp_path):
     rec["result"]["error"] = 1
     _, rows = summarize(tmp_path, {"soak": rec})
     assert rows["soak"][0] == "FAIL"
+
+
+def test_soak_gates_on_outcome_mix(tmp_path):
+    """VERDICT r5 item 6: the ok/aborted/timeout mix is an explicit PASS
+    criterion — the old gate silently tolerated 19% non-ok as long as
+    nothing errored or leaked."""
+    # The soak workload is 20% deliberate aborts: ok at exactly 80% with
+    # the rest aborted is the expected healthy mix.
+    rec = {"rc": 0, "result": {"ops": 160, "ok": 130, "aborted": 30,
+                               "error": 0, "leaks": 0, "ok_per_sec": 22.0}}
+    _, rows = summarize(tmp_path, {"soak": rec})
+    assert rows["soak"][0] == "PASS"
+    # NORMAL requests failing as "aborted" (ok below the 80% floor) must
+    # fail even though errors and leaks are zero.
+    rec["result"].update(ok=120, aborted=40)
+    _, rows = summarize(tmp_path, {"soak": rec})
+    assert rows["soak"][0] == "FAIL"
+    # Accounting must close: ops that vanished from the outcome counters
+    # (neither ok nor aborted nor error) can never summarize clean.
+    rec["result"].update(ok=130, aborted=20)
+    _, rows = summarize(tmp_path, {"soak": rec})
+    assert rows["soak"][0] == "FAIL"
+    assert "UNACCOUNTED" in rows["soak"][1]
 
 
 def test_exit_code_reflects_failures(tmp_path):

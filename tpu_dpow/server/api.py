@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import datetime
 import json
+import math
 import os
 import traceback
 from typing import Optional
@@ -20,6 +21,7 @@ from typing import Optional
 from aiohttp import WSMsgType, web
 
 from .. import obs
+from ..sched import Busy
 from ..utils.logging import get_logger
 from .app import DpowServer
 from .config import ServerConfig
@@ -48,6 +50,17 @@ async def _handle_service_request(server: DpowServer, data) -> dict:
     except RequestTimeout:
         response = {"error": "Timeout reached without work", "timeout": True}
         _responses_counter().inc(1, "timeout")
+    except Busy as e:
+        # Admission control said no (window full / shed / hard over-quota,
+        # tpu_dpow/sched/). One structured shape on both faces: the POST
+        # handler maps it to HTTP 429 + a Retry-After header; websocket
+        # callers read the same fields out of this frame.
+        response = {
+            "error": "Service busy, retry later",
+            "busy": True,
+            "retry_after": max(1, math.ceil(e.retry_after)),
+        }
+        _responses_counter().inc(1, "busy")
     except RetryRequest:
         response = {"error": "Retry request"}
         _responses_counter().inc(1, "retry")
@@ -71,7 +84,16 @@ def build_apps(server: DpowServer, broker=None):
             data = await request.json()
         except (ValueError, json.JSONDecodeError):
             return web.json_response({"error": "Bad request (not json)"})
-        return web.json_response(await _handle_service_request(server, data))
+        response = await _handle_service_request(server, data)
+        if response.get("busy"):
+            # docs/admission.md 429 contract: status + Retry-After header,
+            # body carries the same hint for json-only clients.
+            return web.json_response(
+                response,
+                status=429,
+                headers={"Retry-After": str(response["retry_after"])},
+            )
+        return web.json_response(response)
 
     async def service_ws_handler(request: web.Request) -> web.WebSocketResponse:
         ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=2048)

@@ -32,6 +32,7 @@ from typing import Dict, Optional
 from .. import obs
 from ..models import DifficultyModel, WorkType
 from ..resilience import DispatchSupervisor, SystemClock
+from ..sched import AdmissionController
 from ..store import MemoryStore, Store
 from ..transport import Message, QOS_0, QOS_1, Transport
 from ..transport.mqtt_codec import encode_work_payload, parse_result_payload
@@ -100,6 +101,27 @@ class DpowServer:
         # would serialize unrelated hashes' dispatches behind each other's
         # round trips. Entries live and die with work_futures.
         self._difficulty_locks: Dict[str, asyncio.Lock] = {}
+        # Admission control & fair scheduling (tpu_dpow/sched/): every
+        # dispatch — on-demand and precache — asks this controller for a
+        # window slot first. Defaults leave the window unbounded and the
+        # quota unmetered (seed behavior); an operator sizes
+        # max_inflight_dispatches to the worker fleet and overload turns
+        # into 429 + Retry-After instead of unbounded queue growth
+        # (docs/admission.md).
+        self.admission = AdmissionController(
+            store,
+            clock=self.clock,
+            window=config.max_inflight_dispatches,
+            queue_limit=config.admission_queue_limit,
+            quota_rate=config.quota_rate,
+            quota_burst=config.quota_burst,
+            quota_hard=config.quota_hard,
+            precache_lease=config.precache_lease,
+            busy_retry_after=config.busy_retry_after,
+        )
+        # Window ticket per dispatched hash; lives and dies with the
+        # work_futures entry (released in _drop_dispatch_state).
+        self._dispatch_tickets: Dict[str, object] = {}
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
         self.work_republished = 0  # healed lost publishes (observability)
@@ -160,6 +182,11 @@ class DpowServer:
         ]
         if self.config.work_republish_interval > 0:
             self._tasks.append(asyncio.ensure_future(self.supervisor.run()))
+        self._tasks.append(
+            asyncio.ensure_future(
+                self.admission.run(self.config.admission_poll_interval)
+            )
+        )
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
 
@@ -377,6 +404,10 @@ class DpowServer:
         # which untracks the dispatch — and the hedged flag with it.
         hedged = self.supervisor.was_hedged(block_hash)
         await self.store.set(f"block:{block_hash}", work, expire=self.config.block_expiry)
+        # A precache dispatch holds its admission-window slot as a lease;
+        # the winning result is what releases it (on-demand slots release
+        # with their dispatch state instead — release_key no-ops there).
+        self.admission.release_key(block_hash)
 
         future = self.work_futures.get(block_hash)
         if future is not None and not future.done():
@@ -443,6 +474,16 @@ class DpowServer:
         if not should_precache or not self.config.enable_precache:
             return
 
+        # Admission gate (sched/): precache is speculative and first in
+        # the load-shedding order — a full dispatch window sheds it here,
+        # never queues it ahead of waiting on-demand work. The next
+        # confirmation for this account simply retries.
+        if self.admission.try_acquire_precache(
+            block_hash, difficulty=self.config.base_difficulty
+        ) is None:
+            logger.debug("precache for %s shed: dispatch window full", block_hash)
+            return
+
         # Precache traces start at the queue stage: there is no service
         # accept, the block arrival IS the request.
         trace_id = self._tracer.begin(block_hash, stage="queue")
@@ -468,7 +509,9 @@ class DpowServer:
             # the still-held setnx lock until its TTL (reference parity:
             # dpow_server.py:191-205 only deletes the work key, but its lock
             # has a 5 s TTL and the reference accepts that stall window —
-            # here the retirement is made atomic instead).
+            # here the retirement is made atomic instead). A retired hash
+            # will never see its result: its precache lease goes with it.
+            self.admission.release_key(old_frontier)
             aws.append(
                 self.store.delete(
                     f"block:{old_frontier}",
@@ -477,6 +520,7 @@ class DpowServer:
                 )
             )
         elif previous_exists:
+            self.admission.release_key(previous)
             aws.append(
                 self.store.delete(
                     f"block:{previous}",
@@ -516,6 +560,9 @@ class DpowServer:
         self._dispatched_difficulty.pop(block_hash, None)
         self._difficulty_locks.pop(block_hash, None)
         self.supervisor.untrack(block_hash)
+        ticket = self._dispatch_tickets.pop(block_hash, None)
+        if ticket is not None:
+            self.admission.release(ticket)
         self._m_dispatches.set(len(self.work_futures))
 
     async def _authenticate(self, data: dict) -> str:
@@ -598,6 +645,11 @@ class DpowServer:
                     raise InvalidRequest("Invalid account")
             difficulty = self._resolve_difficulty(data)
             timeout = self._resolve_timeout(data)
+            # Quota ledger (sched/quota.py): one token per request. Soft
+            # mode marks the request over-quota — first in line for load
+            # shedding if a dispatch is needed and the window is full;
+            # hard mode raises Busy here (429 + Retry-After, api.py).
+            over_quota = await self.admission.consume_quota(service)
             self._tracer.begin(block_hash)  # stage: accept
 
             work = await self.store.get(f"block:{block_hash}")
@@ -633,7 +685,8 @@ class DpowServer:
 
             if work_type == WorkType.ONDEMAND.value:
                 work = await self._dispatch_ondemand(
-                    block_hash, account, difficulty, timeout
+                    block_hash, account, difficulty, timeout,
+                    service=service, over_quota=over_quota,
                 )
 
             served["work_type"] = work_type
@@ -661,8 +714,34 @@ class DpowServer:
         account: Optional[str],
         difficulty: int,
         timeout: float,
+        service: str = "",
+        over_quota: bool = False,
     ) -> str:
         created = None
+        ticket = None
+        # One deadline for the whole dispatch: any time spent waiting in
+        # the admission queue below comes OUT of this request's budget —
+        # a caller that asked for 10 s must never wait ~20 (queue + work).
+        deadline = self.clock.time() + timeout
+        if block_hash not in self.work_futures:
+            # Admission window (sched/window.py): a would-be dispatcher
+            # needs a slot before it may create the dispatch. This may
+            # wait in the fair queue (backpressure) or raise Busy (shed /
+            # rejected → 429). With the default unbounded window it
+            # grants synchronously — no await-gap is introduced.
+            ticket = await self.admission.acquire_dispatch(
+                block_hash, service,
+                difficulty=difficulty,
+                deadline=deadline,
+                over_quota=over_quota,
+            )
+            timeout = max(deadline - self.clock.time(), 0.01)
+            if block_hash in self.work_futures:
+                # A concurrent dispatcher won the hash while we waited in
+                # the queue: the dispatch exists, hand the slot back and
+                # join it as a plain waiter below.
+                self.admission.release(ticket)
+                ticket = None
         if block_hash not in self.work_futures:
             # Reserve the entry synchronously — no await sits between the
             # membership check and this assignment — so concurrent base- and
@@ -672,6 +751,10 @@ class DpowServer:
             # erase a raised entry and fail its final validation).
             created = asyncio.get_running_loop().create_future()
             self.work_futures[block_hash] = created
+            # The window slot travels with the dispatch state from here on:
+            # _drop_dispatch_state releases it (every teardown path).
+            self._dispatch_tickets[block_hash] = ticket
+            ticket = None
             self._dispatched_difficulty[block_hash] = difficulty
             self._m_dispatches.set(len(self.work_futures))
             self._tracer.mark_hash(block_hash, "queue")
@@ -679,7 +762,7 @@ class DpowServer:
             # budget); the supervisor holds fire until the first publish is
             # stamped via dispatched(), so it cannot jump the dispatcher's
             # difficulty-entry serialization below.
-            self.supervisor.track(block_hash, self.clock.time() + timeout)
+            self.supervisor.track(block_hash, deadline)
             try:
                 if account:
                     asyncio.ensure_future(
@@ -755,7 +838,7 @@ class DpowServer:
         # budget (the latest deadline wins), so re-dispatch retries keep
         # healing for exactly as long as some waiter can still be answered
         # — and never longer.
-        self.supervisor.track(block_hash, self.clock.time() + timeout)
+        self.supervisor.track(block_hash, deadline)
         try:
             if created is None and difficulty > self._dispatched_difficulty.get(
                 block_hash, self.config.base_difficulty

@@ -62,6 +62,30 @@ class ServerConfig:
     # hash's own pool (a precache-only fleet picks up a stalled on-demand
     # hash rather than letting the request die). 1 = hedge immediately.
     hedge_after: int = 2
+    # -- admission control & fair scheduling (tpu_dpow/sched/) ---------
+    # Bound on concurrently dispatched work (on-demand futures + precache
+    # leases). 0 = unbounded: admission meters but never blocks — the seed
+    # behavior. Size to the worker fleet's launch pipeline.
+    max_inflight_dispatches: int = 0
+    # Admitted-but-waiting bound behind a full window; past it, load is
+    # shed (precache → over-quota → most slack) and callers get 429/busy.
+    admission_queue_limit: int = 64
+    # Per-service token bucket: sustained requests/second and burst
+    # capacity, persisted via the Store (survives restarts/failover).
+    # rate 0 = unlimited (no metering I/O on the hot path).
+    quota_rate: float = 0.0
+    quota_burst: float = 20.0
+    # False (default): an empty bucket marks requests over-quota — first
+    # in line for shedding under load, served normally otherwise.
+    # True: over-quota requests are refused outright (429 + Retry-After).
+    quota_hard: bool = False
+    # Seconds a precache dispatch may hold a window slot with no worker
+    # result before its lease lapses (dead publishes must not pin the
+    # window shut).
+    precache_lease: float = 30.0
+    # Retry-After hint (seconds) carried by shed/rejected responses.
+    busy_retry_after: float = 1.0
+    admission_poll_interval: float = 0.5
     log_file: Optional[str] = None
 
 
@@ -95,6 +119,30 @@ def parse_args(argv=None) -> ServerConfig:
     p.add_argument("--hedge_after", type=int, default=c.hedge_after,
                    help="escalate to hedged dispatch (work/ondemand AND "
                    "work/precache) from this re-dispatch attempt on")
+    p.add_argument("--max_inflight_dispatches", type=int,
+                   default=c.max_inflight_dispatches,
+                   help="admission window: max concurrently dispatched work "
+                   "(0 = unbounded); overload answers 429 + Retry-After")
+    p.add_argument("--admission_queue_limit", type=int,
+                   default=c.admission_queue_limit,
+                   help="admitted-but-waiting bound behind a full window")
+    p.add_argument("--quota_rate", type=float, default=c.quota_rate,
+                   help="per-service sustained requests/second for the "
+                   "store-backed token bucket (0 = unlimited)")
+    p.add_argument("--quota_burst", type=float, default=c.quota_burst,
+                   help="per-service token-bucket burst capacity")
+    p.add_argument("--quota_hard", action="store_true",
+                   help="refuse over-quota requests outright (429) instead "
+                   "of soft-shedding them first under load")
+    p.add_argument("--precache_lease", type=float, default=c.precache_lease,
+                   help="seconds a precache dispatch holds a window slot "
+                   "with no worker result before the lease lapses")
+    p.add_argument("--busy_retry_after", type=float, default=c.busy_retry_after,
+                   help="Retry-After hint (s) on shed/rejected responses")
+    p.add_argument("--admission_poll_interval", type=float,
+                   default=c.admission_poll_interval,
+                   help="seconds between admission sweeps (lapsed precache "
+                   "leases, deadline-expired queued waiters)")
     p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
                    help="seconds between public statistics broadcasts "
                    "(reference: fixed 300)")
