@@ -20,4 +20,10 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 rc=${PIPESTATUS[0]}
 
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+# Fleet-coordination coverage at a glance (ISSUE 4): how many tier-1 tests
+# exercise tpu_dpow/fleet/. Collection only — does not rerun anything.
+FLEET_TESTS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py --collect-only -q -p no:cacheprovider \
+    2>/dev/null | grep -c '::' || true)
+echo "FLEET_TESTS=${FLEET_TESTS}"
 exit "$rc"

@@ -386,6 +386,41 @@ def test_warm_shapes_off_is_transparent():
     assert b._pick_shape(30, 16) == (16, 16)
 
 
+def test_warm_engine_never_compiles_on_the_dispatch_path():
+    """Regression guard for the e2e soak flake: a COLD warm_shapes engine
+    hit by a burst must serve every request from shapes already in the
+    warm set at dispatch time — never launch an unwarmed shape inline.
+    The inline compile of a batched blake2b shape costs seconds on this
+    host; parked on the dispatch path it stalls every in-flight request
+    past the server's 5 s default service timeout, which is exactly how
+    test_e2e_soak_with_cancels_and_timeouts used to time out whenever
+    earlier tests perturbed arrival timing into an uncached shape."""
+
+    async def run():
+        b = make_backend(warm_shapes=True, max_batch=16)
+        await b.setup()
+        real_dispatch = b._dispatch_next
+        cold_dispatches = []
+
+        def recording_dispatch(*args, **kw):
+            rec = real_dispatch(*args, **kw)
+            if rec is not None and rec.shape not in b._warm:
+                cold_dispatches.append(rec.shape)
+            return rec
+
+        b._dispatch_next = recording_dispatch
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(13)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        assert not cold_dispatches, (
+            f"dispatch path launched unwarmed shapes {cold_dispatches}"
+        )
+        await b.close()
+
+    asyncio.run(run())
+
+
 # -- launch timeout (hang protection) -------------------------------------
 
 

@@ -550,7 +550,7 @@ def test_chaos_overload_burst_bounded_window_shed_order_and_recovery():
                     # the window bound must hold at every dispatch the
                     # worker ever observes
                     seen_inflight.append(len(server.work_futures))
-                    bh, diff_hex, _tid = parse_work_payload(msg.payload)
+                    bh, diff_hex, _tid, _rng = parse_work_payload(msg.payload)
                     work = solve(bh, int(diff_hex, 16))
                     work_type = msg.topic.split("/", 1)[1]
                     await worker_transport.publish(

@@ -36,9 +36,21 @@ def run(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=60))
 
 
-def make_client(transport, payout):
-    config = ClientConfig(payout_address=payout, startup_heartbeat_wait=3.0)
-    backend = JaxWorkBackend(kernel="xla", sublanes=8, iters=8)
+def make_client(transport, payout, **config_overrides):
+    config = ClientConfig(
+        payout_address=payout, startup_heartbeat_wait=3.0, **config_overrides
+    )
+    # warm_shapes=True: serve from already-compiled launch shapes and grow
+    # the ladder in the BACKGROUND. With it off (the plain-CPU default), a
+    # burst's first batched pack compiles INLINE on the dispatch path —
+    # ~4-6 s for the batch-16 shape on this host, racing the 5 s default
+    # service timeout. That race was the long-standing soak flake
+    # (test_e2e_soak_with_cancels_and_timeouts timing out ~1 in 5 when
+    # earlier tests perturbed arrival timing): every request of a burst
+    # stalls behind one cold compile. tests/test_backend.py pins the
+    # no-unwarmed-shape-on-the-dispatch-path property as the regression
+    # guard.
+    backend = JaxWorkBackend(kernel="xla", sublanes=8, iters=8, warm_shapes=True)
     return DpowClient(config, transport, backend=backend)
 
 

@@ -1,0 +1,773 @@
+"""Fleet coordination (tpu_dpow/fleet/): codec grammar, registry, planner,
+cover tracker units, then the deterministic acceptance scenario from
+ISSUE 4 — 4 unequal workers sharded over the full u64 space, a mid-dispatch
+worker death re-covered onto a live worker within the waiters' deadline, a
+legacy range-ignoring client coexisting, and exhaustive dpow_fleet_*
+dispatch accounting. FakeClock + in-proc transport throughout: no real
+sleeps beyond event-loop settling.
+"""
+
+import asyncio
+import hashlib
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from tpu_dpow import obs
+from tpu_dpow.backend import WorkBackend
+from tpu_dpow.chaos import FakeClock
+from tpu_dpow.client import ClientConfig, DpowClient
+from tpu_dpow.fleet import (
+    BROADCAST,
+    SHARDED,
+    SPACE,
+    Assignment,
+    CoverageTracker,
+    FleetPlanner,
+    WorkerRegistry,
+)
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import mqtt_codec as mc
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(41)
+EASY = 0xFF00000000000000  # ~256 hashes expected: instant to brute-force
+PAYOUTS = [nc.encode_account(bytes(range(i, i + 32))) for i in range(5)]
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def settle(seconds=0.05):
+    await asyncio.sleep(seconds)
+
+
+def solve_from(block_hash: str, difficulty: int, start: int = 0) -> str:
+    """Brute-force the first valid nonce scanning upward from ``start`` —
+    what a range-honoring engine produces for a shard starting there."""
+    h = bytes.fromhex(block_hash)
+    w = start
+    while True:
+        v = int.from_bytes(
+            hashlib.blake2b(struct.pack("<Q", w & nc.MAX_U64) + h,
+                            digest_size=8).digest(),
+            "little",
+        )
+        if v >= difficulty:
+            return f"{w & nc.MAX_U64:016x}"
+        w += 1
+
+
+# ---------------------------------------------------------- codec grammar
+
+
+def test_work_payload_range_roundtrip_and_goldens():
+    tid = obs.new_trace_id()
+    rng = (0x123456789ABCDEF0, 0x4000000000000000)
+
+    # BYTE GOLDENS: range-free payloads are bit-identical to the pre-fleet
+    # wire format (PR-1 contract), with and without a trace id.
+    assert mc.encode_work_payload("AB", 0xFFFFFFC000000000) == (
+        "AB,ffffffc000000000")
+    assert mc.encode_work_payload("AB", 0xFFFFFFC000000000, tid) == (
+        f"AB,ffffffc000000000,{tid}")
+
+    # range rides as the trailing token, with or without a trace id
+    p = mc.encode_work_payload("AB", 0xFFFFFFC000000000, tid, rng)
+    assert p == f"AB,ffffffc000000000,{tid},123456789abcdef0+4000000000000000"
+    assert mc.parse_work_payload(p) == ("AB", "ffffffc000000000", tid, rng)
+    p2 = mc.encode_work_payload("AB", 0xFFFFFFC000000000, None, rng)
+    assert mc.parse_work_payload(p2) == ("AB", "ffffffc000000000", None, rng)
+
+    # token order on the wire is free (shape-distinguishable)
+    swapped = f"AB,ffffffc000000000,{mc.encode_nonce_range(rng)},{tid}"
+    assert mc.parse_work_payload(swapped) == ("AB", "ffffffc000000000", tid, rng)
+
+    # legacy frames without either token still parse
+    assert mc.parse_work_payload("AB,ffffffc000000000") == (
+        "AB", "ffffffc000000000", None, None)
+    # garbage trailing tokens are ignored, not crashed on
+    assert mc.parse_work_payload("AB,fff,garbage,12+34")[2:] == (None, None)
+
+    # full-space encoding: length 0
+    assert mc.parse_nonce_range("0000000000000000+0000000000000000") == (0, 0)
+    assert mc.parse_nonce_range("not-a-range") is None
+    with pytest.raises(ValueError):
+        mc.encode_nonce_range((1 << 64, 0))
+
+
+# --------------------------------------------------------------- registry
+
+
+def _announce(worker_id, hashrate=0.0, backend="jax", concurrency=8,
+              work=("precache", "ondemand")):
+    return json.dumps({
+        "v": 1, "id": worker_id, "backend": backend,
+        "concurrency": concurrency, "hashrate": hashrate, "work": list(work),
+    })
+
+
+def test_registry_announce_liveness_and_bye():
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0)
+        assert await reg.handle_announce("not json") is None
+        assert await reg.handle_announce(_announce("bad/id")) is None
+        info = await reg.handle_announce(_announce("w1", 5e6))
+        assert info.worker_id == "w1" and info.declared_hashrate == 5e6
+        await reg.handle_announce(_announce("w2", work=["precache"]))
+        assert [i.worker_id for i in reg.live_workers()] == ["w1", "w2"]
+        # work-type filtering
+        assert [i.worker_id for i in reg.live_workers("ondemand")] == ["w1"]
+        # liveness ages on the clock; a re-announce revives
+        await clock.advance(11.0)
+        assert reg.live_workers() == []
+        await reg.handle_announce(_announce("w1", 5e6))
+        assert [i.worker_id for i in reg.live_workers()] == ["w1"]
+        # clean goodbye drops LIVENESS immediately...
+        await reg.handle_announce(json.dumps({"id": "w1", "bye": True}))
+        assert reg.live_workers() == []
+        # ...but never the learned record: a forged bye over the shared
+        # credential must not erase EMAs, and a restarting worker comes
+        # back with its measured weight intact
+        await reg.observe_result("w1", 0, 0)  # no-op sample, record exists
+        assert reg.get("w1") is not None
+        info = await reg.handle_announce(_announce("w1"))
+        assert info.declared_hashrate == 5e6  # capability survived the bye
+        # declared hashrate is clamped: one liar cannot claim the space
+        from tpu_dpow.fleet import registry as reg_mod
+
+        loud = await reg.handle_announce(_announce("w9", 1e30))
+        assert loud.declared_hashrate == reg_mod.MAX_DECLARED_HASHRATE
+
+    run(main())
+
+
+def test_registry_cardinality_bound_evicts_stale_then_refuses():
+    """The shared credential could mint unlimited ids; the registry caps
+    them — fresh ids evict the longest-silent dead record first, and are
+    refused while every slot is live."""
+
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0,
+                             max_workers=3)
+        for i in range(3):
+            assert await reg.handle_announce(_announce(f"w{i}")) is not None
+        # all three live: a 4th id is refused outright
+        assert await reg.handle_announce(_announce("flood")) is None
+        assert reg.get("flood") is None and len(reg.live_workers()) == 3
+        # w0 goes silent past ttl; the fresh id now evicts it
+        await clock.advance(11.0)
+        for i in (1, 2):
+            await reg.handle_announce(_announce(f"w{i}"))
+        assert await reg.handle_announce(_announce("fresh")) is not None
+        assert reg.get("w0") is None and reg.get("fresh") is not None
+
+    run(main())
+
+
+def test_registry_ema_and_restart_persistence():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        reg = WorkerRegistry(store, clock=clock, ttl=10.0, ema_alpha=0.5)
+        await reg.handle_announce(_announce("w1", 1e6))
+        # first sample seeds the EMA, later ones fold in
+        assert await reg.observe_result("w1", 2e6, 1.0) == 2e6
+        assert await reg.observe_result("w1", 4e6, 1.0) == 3e6
+        assert reg.get("w1").hashrate == 3e6  # measured beats declared
+        # EMA updates are memory-only (result hot path); the next announce
+        # refresh is what persists them
+        await reg.handle_announce(_announce("w1", 1e6))
+        # a fresh registry over the same store (server restart) rehydrates
+        # capabilities + EMA, with one ttl of liveness grace
+        reg2 = WorkerRegistry(store, clock=FakeClock(), ttl=10.0)
+        assert await reg2.load() == 1
+        w = reg2.get("w1")
+        assert w.declared_hashrate == 1e6 and w.ema_hashrate == 3e6
+        assert [i.worker_id for i in reg2.live_workers()] == ["w1"]
+
+    run(main())
+
+
+# ---------------------------------------------------------------- planner
+
+
+def _fleet(clock=None, rates=(1e6, 2e6, 3e6, 4e6), ttl=100.0):
+    reg = WorkerRegistry(MemoryStore(), clock=clock or FakeClock(), ttl=ttl)
+
+    async def fill():
+        for i, r in enumerate(rates, 1):
+            await reg.handle_announce(_announce(f"w{i}", r))
+    return reg, fill
+
+
+def test_planner_partition_is_disjoint_covering_and_weighted():
+    async def main():
+        reg, fill = _fleet()
+        await fill()
+        planner = FleetPlanner(reg, min_workers=2)
+        plan = planner.plan(EASY, "ondemand")
+        assert plan.mode == SHARDED
+        assert len(plan.assignments) == 4
+        # disjoint + covering: sorted starts chain exactly over [0, 2^64)
+        by_start = sorted(plan.assignments, key=lambda a: a.start)
+        assert by_start[0].start == 0
+        pos = 0
+        for a in by_start:
+            assert a.start == pos
+            pos += a.span
+        assert pos == SPACE
+        # hashrate-weighted: w4 (4e6) gets ~4x w1's span
+        spans = {a.worker_id: a.span for a in plan.assignments}
+        assert spans["w4"] / spans["w1"] == pytest.approx(4.0, rel=0.01)
+        # every nonce belongs to exactly one shard
+        for nonce in (0, 1, SPACE // 3, SPACE - 1):
+            assert sum(a.covers(nonce) for a in plan.assignments) == 1
+
+    run(main())
+
+
+def test_planner_falls_back_to_broadcast_when_fleet_small_or_stale():
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0)
+        planner = FleetPlanner(reg, min_workers=2)
+        # empty registry
+        assert planner.plan(EASY, "ondemand").mode == BROADCAST
+        # one worker: too small
+        await reg.handle_announce(_announce("w1", 1e6))
+        assert planner.plan(EASY, "ondemand").mode == BROADCAST
+        # two workers: shards
+        await reg.handle_announce(_announce("w2", 1e6))
+        assert planner.plan(EASY, "ondemand").mode == SHARDED
+        # stale registry: every worker aged out -> broadcast again
+        await clock.advance(11.0)
+        assert planner.plan(EASY, "ondemand").mode == BROADCAST
+
+    run(main())
+
+
+def test_planner_horizon_right_sizes_and_rotates():
+    async def main():
+        reg, fill = _fleet(rates=(1e6, 1e6, 1e6, 1e6))
+        await fill()
+        # EASY ~ 256 expected hashes; 1e6 H/s covers that in microseconds,
+        # so a 1 s horizon needs exactly one worker per dispatch.
+        planner = FleetPlanner(reg, min_workers=2, horizon=1.0, safety=4.0)
+        picked = set()
+        for _ in range(8):
+            plan = planner.plan(EASY, "ondemand")
+            assert plan.mode == SHARDED
+            assert len(plan.assignments) == 1
+            # a lone shard still covers the whole space
+            assert plan.assignments[0].span == SPACE
+            picked.add(plan.assignments[0].worker_id)
+        # the cursor rotates the load across the fleet
+        assert len(picked) == 4
+
+    run(main())
+
+
+# ------------------------------------------------------------------ cover
+
+
+def test_cover_attribution_and_liveness_split():
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0)
+        await reg.handle_announce(_announce("w1", 1e6))
+        await reg.handle_announce(_announce("w2", 1e6))
+        cover = CoverageTracker(reg)
+        half = SPACE // 2
+        assignments = [
+            Assignment("w1", 0, half), Assignment("w2", half, SPACE - half),
+        ]
+        h = random_hash()
+        cover.begin(h, "ondemand", EASY, assignments, clock.time())
+        await clock.advance(2.0)
+        # a nonce in w2's shard attributes there, with scanned = offset + 1
+        owner, hashes, elapsed = cover.resolve(h, half + 999, clock.time())
+        assert (owner, hashes, elapsed) == ("w2", 1000.0, 2.0)
+        # untracked hash -> None
+        assert cover.resolve(random_hash(), 1, clock.time()) is None
+        # w1 dies (no announce past ttl, w2 refreshed): split sees it
+        await clock.advance(9.0)
+        await reg.handle_announce(_announce("w2", 1e6))
+        alive, orphaned = cover.split_by_liveness(h)
+        assert [a.worker_id for a in alive] == ["w2"]
+        assert [a.worker_id for a in orphaned] == ["w1"]
+        # after reassignment the shard belongs to w2 for attribution, and
+        # only THAT shard's clock restarts
+        t_reassign = clock.time()
+        cover.reassigned(h, orphaned[0], "w2", t_reassign)
+        await clock.advance(3.0)
+        owner, hashes, elapsed = cover.resolve(h, 5, clock.time())
+        assert owner == "w2" and hashes == 6.0 and elapsed == 3.0
+        # the untouched shard's elapsed still runs from the DISPATCH — a
+        # re-cover elsewhere must not inflate its eventual EMA sample
+        owner, _, elapsed = cover.resolve(h, half + 1, clock.time())
+        assert owner == "w2" and elapsed == clock.time() - 0.0
+        cover.forget(h)
+        assert not cover.tracked(h)
+
+    run(main())
+
+
+class _CapturingTransport:
+    def __init__(self):
+        self.published = []
+
+    async def publish(self, topic, payload, qos=0):
+        self.published.append((topic, payload))
+
+
+def test_republish_sends_one_range_per_owner_and_counts_recover_once():
+    """A worker that took over a dead neighbor's shard holds two ranges;
+    republish must send only the freshest (the one its single job scans)
+    or every grace window would rebase the job back and forth, discarding
+    a window of scan progress per flip. And an orphaned shard is counted
+    re-covered ONCE, not once per grace window."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0)
+        await reg.handle_announce(_announce("w1", 1e6))
+        await reg.handle_announce(_announce("w2", 1e6))
+        from tpu_dpow.fleet import FleetCoordinator
+
+        transport = _CapturingTransport()
+        cover = CoverageTracker(reg)
+        coord = FleetCoordinator(
+            reg, FleetPlanner(reg, min_workers=2), cover, transport,
+            clock=clock,
+        )
+        h = random_hash()
+        half = SPACE // 2
+        cover.begin(h, "ondemand", EASY, [
+            Assignment("w1", 0, half), Assignment("w2", half, SPACE - half),
+        ], clock.time())
+        # w2 dies; w1 stays live
+        await clock.advance(11.0)
+        await reg.handle_announce(_announce("w1", 1e6))
+        ctr = obs.get_registry().counter("dpow_fleet_ranges_recovered_total")
+        base = ctr.value()
+        # fire 1: w1's own shard to its lane + w2's shard reassigned to w1
+        assert await coord.republish(h, EASY, "ondemand", hedged=False)
+        lanes1 = [t for t, _ in transport.published]
+        assert lanes1.count("work/ondemand/w1") == 2
+        assert ctr.value() == base + 1
+        # fire 2: only w1's FRESHEST shard (the re-covered one) re-sent —
+        # one range per owner, and no double count
+        transport.published.clear()
+        assert await coord.republish(h, EASY, "ondemand", hedged=False)
+        assert len(transport.published) == 1
+        topic, payload = transport.published[0]
+        assert topic == "work/ondemand/w1"
+        assert mc.parse_work_payload(payload)[3] == (half, SPACE - half)
+        assert ctr.value() == base + 1
+
+        # nobody live at all: orphan broadcasts count once, then re-send
+        # without re-counting
+        h2 = random_hash()
+        cover.begin(h2, "ondemand", EASY, [
+            Assignment("w1", 0, half), Assignment("w2", half, SPACE - half),
+        ], clock.time())
+        await clock.advance(11.0)  # everyone stale
+        transport.published.clear()
+        assert await coord.republish(h2, EASY, "ondemand", hedged=False)
+        assert ctr.value() == base + 3
+        assert all(t == "work/ondemand" for t, _ in transport.published)
+        transport.published.clear()
+        assert await coord.republish(h2, EASY, "ondemand", hedged=False)
+        assert len(transport.published) == 2  # re-broadcast both shards
+        assert ctr.value() == base + 3  # ...but no re-count
+
+    run(main())
+
+
+def test_resolve_rejects_implausible_offsets():
+    """A legacy full-space racer's win can land INSIDE a live worker's
+    shard; (nonce - start) would then be a wildly inflated hashes sample.
+    Offsets beyond any plausible scan-from-start are unattributable."""
+
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0)
+        await reg.handle_announce(_announce("w1", 1e6))
+        cover = CoverageTracker(reg)
+        h = random_hash()
+        cover.begin(h, "ondemand", EASY, [Assignment("w1", 0, 0)], 0.0)
+        await clock.advance(1.0)
+        # plausible offset (~256 expected at EASY): attributed
+        assert cover.resolve(h, 1000, clock.time())[0] == "w1"
+        # a nonce 2^40 deep could not have come from a scan at this
+        # difficulty: rejected, EMA untouched
+        assert cover.resolve(h, 1 << 40, clock.time()) is None
+
+    run(main())
+
+
+def test_handler_recover_reaches_queued_entry_too():
+    """A re-covered shard can land while the hash is still QUEUED (every
+    worker slot busy); the queued entry must take the new range — deduping
+    it would leave the orphaned shard unscanned until hedge escalation."""
+    from tpu_dpow.client.work_handler import WorkHandler
+    from tpu_dpow.models import WorkRequest
+
+    async def main():
+        backend = ScriptedBackend()
+        handler = WorkHandler(backend, lambda r, w: None, concurrency=1)
+        await handler.start()
+        h1, h2 = random_hash(), random_hash()
+        await handler.queue_work(WorkRequest(h1, EASY))
+        for _ in range(100):
+            if h1 in backend.futures:
+                break
+            await asyncio.sleep(0.01)
+        old = (0, 1 << 62)
+        new = (1 << 63, 1 << 62)
+        await handler.queue_work(WorkRequest(h2, EASY, nonce_range=old))
+        await handler.queue_work(WorkRequest(h2, EASY, nonce_range=new))
+        assert handler.queue.get(h2).nonce_range == new
+        assert handler.stats["recovered"] == 1
+        await handler.stop()
+
+    run(main())
+
+
+def test_win_in_dead_workers_shard_does_not_resurrect_it():
+    """A broadcast-recovered shard can be solved by ANYONE; attributing
+    that win to the shard's dead owner would stamp the corpse live again
+    and shard the next dispatch onto a lane nobody subscribes."""
+
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(MemoryStore(), clock=clock, ttl=10.0)
+        await reg.handle_announce(_announce("w1", 1e6))
+        from tpu_dpow.fleet import FleetCoordinator
+
+        class NullTransport:
+            async def publish(self, *a, **kw):
+                pass
+
+        cover = CoverageTracker(reg)
+        coord = FleetCoordinator(
+            reg, FleetPlanner(reg, min_workers=1), cover, NullTransport(),
+            clock=clock,
+        )
+        h = random_hash()
+        cover.begin(h, "ondemand", EASY, [Assignment("w1", 0, 0)], 0.0)
+        await clock.advance(11.0)  # w1 ages out
+        assert not reg.is_live("w1")
+        await coord.on_winner(h, f"{123:016x}")
+        assert not reg.is_live("w1"), "dead worker resurrected by a win"
+        assert reg.get("w1").ema_hashrate == 0.0
+
+    run(main())
+
+
+def test_handler_raise_with_new_range_rebases_or_keeps_old_label():
+    """A raised re-target that also re-shards must reach the engine's scan
+    base; an engine that cannot rebase must keep the OLD range label so a
+    later re-publish of the shard is not deduped as already-covered."""
+    from tpu_dpow.client.work_handler import WorkHandler
+    from tpu_dpow.models import WorkRequest
+
+    class Backend(ScriptedBackend):
+        def __init__(self, can_cover):
+            super().__init__()
+            self.can_cover = can_cover
+            self.targets = {}
+
+        async def raise_difficulty(self, block_hash, difficulty):
+            self.targets[block_hash] = difficulty
+            return True
+
+        async def cover_range(self, block_hash, nonce_range):
+            if not self.can_cover:
+                return False
+            return await super().cover_range(block_hash, nonce_range)
+
+    async def main():
+        for can_cover in (True, False):
+            backend = Backend(can_cover)
+            handler = WorkHandler(backend, lambda r, w: None, concurrency=1)
+            await handler.start()
+            h = random_hash()
+            old = (0, 1 << 63)
+            new = (1 << 63, 0)
+            hard = 0xFFF0000000000000  # strictly above EASY: a real raise
+            await handler.queue_work(WorkRequest(h, EASY, nonce_range=old))
+            for _ in range(100):
+                if h in backend.futures:
+                    break
+                await asyncio.sleep(0.01)
+            await handler.queue_work(WorkRequest(h, hard, nonce_range=new))
+            assert backend.targets[h] == hard
+            if can_cover:
+                assert backend.covered[h] == new
+                assert handler.ongoing[h].request.nonce_range == new
+            else:
+                assert h not in backend.covered
+                # old label kept -> a re-publish of `new` can retry the
+                # rebase instead of being deduped
+                assert handler.ongoing[h].request.nonce_range == old
+                assert handler.ongoing[h].request.difficulty == hard
+            await handler.stop()
+
+    run(main())
+
+
+def test_chaos_demo_fleet_scenario_completes():
+    """scripts/chaos_demo.py's fleet walkthrough (join -> shard -> kill ->
+    re-cover -> result) is operator-facing documentation — keep it live."""
+    from tpu_dpow.scripts.chaos_demo import fleet_scenario
+
+    result = run(fleet_scenario())
+    assert result["result_landed"]
+    assert result["recovered_ranges"] >= 1
+    modes = result["metrics"]["dpow_fleet_dispatch_total"]["series"]
+    assert modes.get("sharded", 0) >= 1
+
+
+# ------------------------------------------------- acceptance (ISSUE 4)
+
+
+class ScriptedBackend(WorkBackend):
+    """Records every request (with its nonce range); the test decides who
+    solves. cover_range follows the jax/native rebase contract."""
+
+    def __init__(self):
+        self.requests = {}  # hash -> latest WorkRequest seen
+        self.futures = {}
+        self.covered = {}  # hash -> re-covered range
+
+    async def setup(self):
+        pass
+
+    async def generate(self, request):
+        self.requests[request.block_hash] = request
+        fut = asyncio.get_running_loop().create_future()
+        self.futures[request.block_hash] = fut
+        return await fut
+
+    async def cancel(self, block_hash):
+        fut = self.futures.get(block_hash)
+        if fut and not fut.done():
+            from tpu_dpow.backend import WorkCancelled
+
+            fut.set_exception(WorkCancelled(block_hash))
+
+    async def cover_range(self, block_hash, nonce_range):
+        if block_hash not in self.futures or self.futures[block_hash].done():
+            return False
+        self.covered[block_hash] = nonce_range
+        return True
+
+    def solve(self, block_hash, work):
+        fut = self.futures.get(block_hash)
+        if fut and not fut.done():
+            fut.set_result(work)
+
+
+async def _start_fleet_stack(clock, broker, store, rates, **server_overrides):
+    config = ServerConfig(
+        base_difficulty=EASY, throttle=1000.0, heartbeat_interval=0.05,
+        statistics_interval=3600.0, work_republish_interval=2.0,
+        # hedging abandons shard coordination for raw redundancy; park it
+        # far out so the scenario exercises the re-cover path first
+        hedge_after=10,
+        fleet_worker_ttl=5.0, **server_overrides,
+    )
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"), clock=clock
+    )
+    await server.setup()
+    server.start_loops()
+    await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                     "public": "N", "precache": "0",
+                                     "ondemand": "0"})
+    await store.sadd("services", "svc")
+
+    clients = []
+    for i, rate in enumerate(rates, 1):
+        backend = ScriptedBackend()
+        c = DpowClient(
+            ClientConfig(
+                payout_address=PAYOUTS[i % len(PAYOUTS)],
+                startup_heartbeat_wait=3.0,
+                worker_id=f"w{i}",
+                declared_hashrate=rate,
+                fleet_announce_interval=3600.0,  # announces driven by test
+            ),
+            InProcTransport(broker, client_id=f"worker{i}", clean_session=False),
+            backend=backend,
+        )
+        await c.setup()
+        c.start_loops()
+        clients.append(c)
+    return server, clients
+
+
+def test_fleet_acceptance_shard_kill_recover_legacy_metrics():
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore()
+        rates = (1e6, 2e6, 3e6, 4e6)
+        server, clients = await _start_fleet_stack(clock, broker, store, rates)
+        # a legacy, range-ignoring worker coexists on the broadcast topic
+        legacy_backend = ScriptedBackend()
+        legacy = DpowClient(
+            ClientConfig(payout_address=PAYOUTS[0],
+                         startup_heartbeat_wait=3.0, fleet=False),
+            InProcTransport(broker, client_id="legacy", clean_session=False),
+            backend=legacy_backend,
+        )
+        await legacy.setup()
+        legacy.start_loops()
+        try:
+            await settle()
+            live = server.fleet_registry.live_workers("ondemand")
+            assert [i.worker_id for i in live] == ["w1", "w2", "w3", "w4"]
+
+            # ---- dispatch 1: sharded across 4 unequal workers ----------
+            h1 = random_hash()
+            req = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h1, "timeout": 25}
+            ))
+            await settle()
+            shards = {}
+            for i, c in enumerate(clients, 1):
+                got = c.work_handler.backend.requests.get(h1)
+                assert got is not None, f"w{i} never saw the dispatch"
+                assert got.nonce_range is not None
+                shards[f"w{i}"] = got.nonce_range
+            # the legacy client hears nothing for a fully sharded dispatch
+            assert h1 not in legacy_backend.requests
+            # disjoint, covering, hashrate-weighted
+            spans = {
+                w: (length or SPACE) for w, (start, length) in shards.items()
+            }
+            assert sum(spans.values()) == SPACE
+            starts = sorted(start for start, _ in shards.values())
+            pos = 0
+            for s in starts:
+                assert s == pos
+                pos += spans[
+                    next(w for w, (st, _) in shards.items() if st == s)
+                ]
+            assert spans["w4"] / spans["w1"] == pytest.approx(4.0, rel=0.01)
+
+            # ---- kill w4 mid-dispatch; its shard must be re-covered ----
+            w4 = clients[3]
+            w4.config.fleet = False  # die silently: no goodbye announce
+            await w4.close()
+            # w4 ages out (ttl 5) while the other three keep announcing;
+            # supervisor polls during the advances see the dispatch silent
+            # — until w4 is stale those re-publishes go shard-to-own-lane
+            # (deduped client-side), THEN the orphaned shard moves.
+            for _ in range(2):
+                await clock.advance(2.0)
+                for c in clients[:3]:
+                    await c._announce()
+                await settle()
+            await clock.advance(2.0)  # t=6: w4 stale, w1-3 fresh -> re-cover
+            await settle()
+            recovered = {
+                f"w{i}": c.work_handler.backend.covered.get(h1)
+                for i, c in enumerate(clients[:3], 1)
+            }
+            taken = [r for r in recovered.values() if r is not None]
+            assert taken == [shards["w4"]], (
+                f"expected exactly w4's shard re-covered, got {recovered}"
+            )
+            reg = obs.get_registry()
+            assert reg.counter(
+                "dpow_fleet_ranges_recovered_total").value() == 1
+
+            # ---- the re-covering worker solves FROM w4's shard ---------
+            taker = next(
+                c for c in clients[:3]
+                if c.work_handler.backend.covered.get(h1) is not None
+            )
+            # a beat of clock so the attribution sample has elapsed > 0
+            await clock.advance(0.5)
+            start = shards["w4"][0]
+            work = solve_from(h1, EASY, start)
+            taker.work_handler.backend.solve(h1, work)
+            resp = await asyncio.wait_for(req, 10)
+            assert resp["work"] == work
+            nc.validate_work(h1, work, EASY)
+            await settle()
+            # attribution: the winning nonce lies in w4's (re-covered)
+            # shard, so the EMA sample lands on the taker
+            taker_id = taker.worker_id
+            assert server.fleet_registry.get(taker_id).ema_hashrate > 0
+
+            # ---- dispatch 2: legacy coexistence via ranged broadcast ---
+            # Once the fleet shrinks below min_workers the planner falls
+            # back to broadcast and the legacy client races too.
+            for c in clients[:2]:
+                c.config.fleet = False
+                await c.close()
+            await clock.advance(6.0)
+            await clients[2]._announce()
+            await settle()
+            h2 = random_hash()
+            req2 = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h2, "timeout": 25}
+            ))
+            await settle()
+            assert legacy_backend.requests.get(h2) is not None
+            # the legacy request carries no range -> full-space race
+            assert legacy_backend.requests[h2].nonce_range is None
+            legacy_backend.solve(h2, solve_from(h2, EASY, 0))
+            resp2 = await asyncio.wait_for(req2, 10)
+            nc.validate_work(h2, resp2["work"], EASY)
+
+            # a ranged payload fed straight to the broadcast topic is
+            # parsed by the legacy client and the range simply ignored
+            h3 = random_hash()
+            await server.transport.publish(
+                "work/ondemand",
+                mc.encode_work_payload(h3, EASY, None, (123, 1 << 40)),
+                qos=0,
+            )
+            await settle()
+            assert legacy_backend.requests.get(h3) is not None
+            assert legacy_backend.requests[h3].nonce_range == (123, 1 << 40)
+
+            # ---- metrics: every dispatch accounted sharded XOR broadcast
+            sharded = reg.counter(
+                "dpow_fleet_dispatch_total", labelnames=("mode",)
+            ).value("sharded")
+            broadcast = reg.counter(
+                "dpow_fleet_dispatch_total", labelnames=("mode",)
+            ).value("broadcast")
+            # dispatch 1 (sharded) + supervisor re-publishes are not new
+            # dispatches; dispatch 2 (broadcast). The exact counts:
+            assert sharded == 1, (sharded, broadcast)
+            assert broadcast == 1, (sharded, broadcast)
+        finally:
+            for c in clients[2:3]:
+                if c.transport.connected:
+                    await c.close()
+            await legacy.close()
+            await server.close()
+
+    run(main())

@@ -8,9 +8,16 @@ import asyncio
 import json
 
 import pytest
-import websockets
 
-from tpu_dpow.server.nano_ws import NanoWebsocketClient
+# Gated exactly like tpu_dpow/server/nano_ws.py gates its own import: this
+# environment may not ship the ``websockets`` package, and a bare import
+# here turned the whole module into a tier-1 COLLECTION ERROR instead of a
+# clean skip (tests/test_nano_backoff.py covers the no-websockets paths).
+websockets = pytest.importorskip(
+    "websockets", reason="websockets package not installed in this image"
+)
+
+from tpu_dpow.server.nano_ws import NanoWebsocketClient  # noqa: E402
 
 
 def run(coro):

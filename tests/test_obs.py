@@ -242,10 +242,10 @@ def test_payload_helpers_roundtrip_and_backward_compat():
     tid = obs.new_trace_id()
     p = mc.encode_work_payload("AB", 0xFFFFFFC000000000, tid)
     assert p == f"AB,ffffffc000000000,{tid}"
-    assert mc.parse_work_payload(p) == ("AB", "ffffffc000000000", tid)
+    assert mc.parse_work_payload(p) == ("AB", "ffffffc000000000", tid, None)
     # pre-trace peers' payloads parse unchanged
     assert mc.parse_work_payload("AB,ffffffc000000000") == (
-        "AB", "ffffffc000000000", None)
+        "AB", "ffffffc000000000", None, None)
     # a non-trace trailing token is ignored, not crashed on
     assert mc.parse_work_payload("AB,fff,garbage")[2] is None
     with pytest.raises(ValueError):

@@ -120,7 +120,7 @@ class OverloadHarness:
         async def loop():
             async for msg in t.messages():
                 if msg.topic.startswith("work/"):
-                    bh, diff_hex, _tid = parse_work_payload(msg.payload)
+                    bh, diff_hex, _tid, _rng = parse_work_payload(msg.payload)
                     work = solve(bh, int(diff_hex, 16))
                     work_type = msg.topic.split("/", 1)[1]
                     await t.publish(f"result/{work_type}", f"{bh},{work},{ACCOUNT}")

@@ -97,7 +97,7 @@ class Harness:
                 if msg.topic.startswith("work/") and respond:
                     # The shared payload grammar: work carries an optional
                     # trailing trace id now (transport/mqtt_codec.py).
-                    bh, diff_hex, _tid = parse_work_payload(msg.payload)
+                    bh, diff_hex, _tid, _rng = parse_work_payload(msg.payload)
                     work = solve(bh, int(diff_hex, 16))
                     work_type = msg.topic.split("/", 1)[1]
                     await t.publish(f"result/{work_type}", f"{bh},{work},{account}")
@@ -108,7 +108,7 @@ class Harness:
 
 def wire(payload: str) -> str:
     """The hash,difficulty part of a work payload (trace id stripped)."""
-    bh, diff_hex, _tid = parse_work_payload(payload)
+    bh, diff_hex, _tid, _rng = parse_work_payload(payload)
     return f"{bh},{diff_hex}"
 
 

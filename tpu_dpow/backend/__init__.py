@@ -89,6 +89,19 @@ class WorkBackend(abc.ABC):
         """
         return False
 
+    async def cover_range(self, block_hash: str, nonce_range: tuple) -> bool:
+        """Re-aim a RUNNING job's scan at ``nonce_range``; True if it took.
+
+        The fleet re-cover path (tpu_dpow.fleet docs/fleet.md): when a
+        sharded dispatch's worker dies, the server hands the orphaned
+        range to a live worker that is usually ALREADY scanning its own
+        shard of the same hash. Engines that can rebase the running scan
+        jump it to the orphaned shard's start; the default says "can't"
+        (False) and the caller drops the hint — a range-ignoring engine is
+        racing the full space anyway, which is always correct.
+        """
+        return False
+
     async def close(self) -> None:  # pragma: no cover - trivial default
         return None
 

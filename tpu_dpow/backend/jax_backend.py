@@ -397,7 +397,17 @@ class JaxWorkBackend(WorkBackend):
             base=0,
             t_submit=time.perf_counter(),
         )
-        job.set_base(secrets.randbits(64))
+        # Sharded dispatch (tpu_dpow.fleet): an assigned nonce range pins
+        # the scan base to the shard start — fleet-level decorrelation by
+        # construction. Without one, a random base decorrelates this worker
+        # from the racing swarm (SURVEY.md §2.5). The range end is soft:
+        # the scan advances past it rather than stranding a dispatch whose
+        # shard holds no solution (the server re-covers dead shards; a live
+        # worker overrunning into a neighbor's shard is just redundancy).
+        if request.nonce_range is not None:
+            job.set_base(request.nonce_range[0])
+        else:
+            job.set_base(secrets.randbits(64))
         self._jobs[key] = job
         self._ensure_engine()
         self._wakeup.set()
@@ -424,6 +434,22 @@ class JaxWorkBackend(WorkBackend):
             return False
         if difficulty > job.difficulty:
             job.set_difficulty(difficulty)
+        return True
+
+    async def cover_range(self, block_hash: str, nonce_range: tuple) -> bool:
+        """Fleet re-cover: jump a running job's scan to an orphaned shard.
+
+        The next pack dispatches from the new base; chunks already in
+        flight finish their old span and apply normally (a hit there is
+        still a valid nonce). Coverage accounting resets — the in-flight
+        spans no longer predict the new region.
+        """
+        job = self._jobs.get(nc.validate_block_hash(block_hash))
+        if job is None or job.cancelled or job.future.done():
+            return False
+        job.set_base(nonce_range[0])
+        job.inflight_miss = 1.0
+        self._wakeup.set()
         return True
 
     async def close(self) -> None:

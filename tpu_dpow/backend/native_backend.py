@@ -188,6 +188,7 @@ class _NativeJob:
     cancel_flag: ctypes.c_int32
     waiters: int = 0  # refcount: last cancelled waiter aborts the scan
     task: Optional[asyncio.Task] = None  # strong ref: the loop holds tasks weakly
+    rebase: Optional[int] = None  # fleet re-cover: jump scan here next chunk
 
 
 class NativeWorkBackend(WorkBackend):
@@ -294,7 +295,8 @@ class NativeWorkBackend(WorkBackend):
             # keeps the strong reference (the event loop holds tasks weakly
             # — a GC'd task would strand every waiter on a dead future).
             job.task = asyncio.ensure_future(
-                self._run_job(key, request.hash_bytes, job)
+                self._run_job(key, request.hash_bytes, job,
+                              nonce_range=request.nonce_range)
             )
         return await self._await_job(job)
 
@@ -304,10 +306,22 @@ class NativeWorkBackend(WorkBackend):
 
         return await await_shared_job(job, abort)
 
-    async def _run_job(self, key: str, hash_bytes: bytes, job: _NativeJob) -> None:
-        base = secrets.randbits(64)  # decorrelating random start (SURVEY §2.5)
+    async def _run_job(
+        self, key: str, hash_bytes: bytes, job: _NativeJob, nonce_range=None
+    ) -> None:
+        # A sharded-dispatch range (tpu_dpow.fleet) pins the start to the
+        # shard; otherwise a random base decorrelates from the racing swarm
+        # (SURVEY §2.5). The range end is soft — see WorkRequest.nonce_range.
+        if nonce_range is not None:
+            base = nonce_range[0]
+        else:
+            base = secrets.randbits(64)
         try:
             while not job.future.done():
+                # Fleet re-cover: jump the scan to an orphaned shard's start
+                # (cover_range). Checked between chunks, like cancels.
+                if job.rebase is not None:
+                    base, job.rebase = job.rebase, None
                 # Snapshot: a dedup waiter may raise job.difficulty mid-chunk.
                 difficulty = job.difficulty
                 found, nonce, hashes = await asyncio.to_thread(
@@ -368,6 +382,14 @@ class NativeWorkBackend(WorkBackend):
             return False
         if difficulty > job.difficulty:
             job.difficulty = difficulty
+        return True
+
+    async def cover_range(self, block_hash: str, nonce_range: tuple) -> bool:
+        """Fleet re-cover: the scan loop rebases between chunks."""
+        job = self._jobs.get(nc.validate_block_hash(block_hash))
+        if job is None or job.future.done():
+            return False
+        job.rebase = nonce_range[0] & nc.MAX_U64
         return True
 
     async def close(self) -> None:

@@ -59,6 +59,10 @@ class DpowClient:
         )
         self.last_heartbeat: Optional[float] = None
         self._server_online = True
+        # Fleet identity (tpu_dpow/fleet/): announced on fleet/announce,
+        # and the suffix of this worker's private sharded-dispatch lane
+        # work/{type}/{worker_id}.
+        self.worker_id = config.resolve_worker_id()
         self._tasks: list = []
         self._metrics_runner = None
         self.metrics_port: Optional[int] = None  # bound port once serving
@@ -158,11 +162,21 @@ class DpowClient:
         for work_type in self.config.work_type.topics:
             await self.transport.subscribe(f"work/{work_type}", qos=QOS_0)
             await self.transport.subscribe(f"cancel/{work_type}", qos=QOS_1)
+            if self.config.fleet:
+                # Private sharded-dispatch lane (docs/fleet.md): ranged
+                # work assignments land here; the broadcast subscription
+                # above stays — the server falls back to it whenever the
+                # fleet registry is too small or stale.
+                await self.transport.subscribe(
+                    f"work/{work_type}/{self.worker_id}", qos=QOS_0
+                )
         if self.config.payout_address:
             await self.transport.subscribe(
                 f"client/{self.config.payout_address}", qos=QOS_1
             )
         await self.work_handler.start()
+        if self.config.fleet:
+            await self._announce()
         await self._start_metrics_app()
         # One startup line (reference client logs its connection status): a
         # healthy worker is otherwise silent until the first stats snapshot,
@@ -195,6 +209,37 @@ class DpowClient:
         self.metrics_port = site._server.sockets[0].getsockname()[1]
         logger.info("metrics served on :%d/metrics", self.metrics_port)
 
+    async def _announce(self, bye: bool = False) -> None:
+        """Publish this worker's capability record to the fleet registry
+        (fleet/registry.py). QoS 1: a join must not evaporate into a
+        server blip the way QoS-0 work messages may."""
+        if bye:
+            payload = {"v": 1, "id": self.worker_id, "bye": True}
+        else:
+            payload = {
+                "v": 1,
+                "id": self.worker_id,
+                "backend": self.config.backend,
+                "concurrency": self.work_handler.concurrency,
+                "hashrate": self.config.declared_hashrate,
+                "work": self.config.work_type.topics,
+            }
+        await self.transport.publish(
+            "fleet/announce", json.dumps(payload), qos=QOS_1
+        )
+
+    async def _announce_loop(self) -> None:
+        """Re-announce on an interval — the fleet heartbeat. A worker that
+        stops ticking ages out of the registry (server fleet_worker_ttl)
+        and its in-flight shards are re-covered onto the rest of the
+        fleet."""
+        while True:
+            await asyncio.sleep(self.config.fleet_announce_interval)
+            try:
+                await self._announce()
+            except Exception as e:
+                logger.warning("fleet announce failed: %s", e)
+
     async def _await_first_heartbeat(self) -> None:
         async for msg in self.transport.messages():
             if msg.topic == "heartbeat":
@@ -208,7 +253,10 @@ class DpowClient:
         if topic == "heartbeat":
             self.last_heartbeat = time.monotonic()
         elif topic.startswith("work/"):
-            await self.handle_work(topic.split("/", 1)[1], msg.payload)
+            # work/{type} (broadcast) or work/{type}/{worker_id} (this
+            # worker's sharded-dispatch lane) — the type is segment 1
+            # either way, and we only ever subscribe our own lane.
+            await self.handle_work(topic.split("/")[1], msg.payload)
         elif topic.startswith("cancel/"):
             await self.work_handler.queue_cancel(msg.payload.strip())
         elif topic.startswith("client/"):
@@ -216,11 +264,18 @@ class DpowClient:
 
     async def handle_work(self, work_type: str, payload: str) -> None:
         try:
-            block_hash, difficulty_hex, trace_id = parse_work_payload(payload)
+            block_hash, difficulty_hex, trace_id, nonce_range = (
+                parse_work_payload(payload)
+            )
             request = WorkRequest(
                 block_hash=block_hash,
                 difficulty=int(difficulty_hex, 16),
                 work_type=WorkType(work_type),
+                # Sharded-dispatch assignment (fleet/planner.py): the
+                # engine pins its scan base to the shard start. A legacy
+                # build of this client parses the same payload and simply
+                # never sees the field — it races the full space.
+                nonce_range=nonce_range,
             )
         except (ValueError, nc.InvalidBlockHash, nc.InvalidDifficulty) as e:
             logger.warning("could not parse work message %r: %s", payload, e)
@@ -289,6 +344,8 @@ class DpowClient:
             asyncio.ensure_future(self._heartbeat_check_loop()),
             asyncio.ensure_future(self._engine_stats_loop()),
         ]
+        if self.config.fleet:
+            self._tasks.append(asyncio.ensure_future(self._announce_loop()))
 
     async def _engine_stats_loop(self, interval: float = 60.0) -> None:
         """Periodic one-line operator snapshot: handler counters (queued /
@@ -322,7 +379,7 @@ class DpowClient:
                     raise
                 logger.error("reconnect setup failed; retrying in %.0fs:\n%s",
                              self.config.reconnect_delay, traceback.format_exc())
-                await self.close()
+                await self.close(reconnecting=True)
                 await asyncio.sleep(self.config.reconnect_delay)
                 continue
             first = False
@@ -350,10 +407,20 @@ class DpowClient:
             except Exception:
                 logger.error("client crashed; reconnecting in %.0fs:\n%s",
                              self.config.reconnect_delay, traceback.format_exc())
-                await self.close()
+                await self.close(reconnecting=True)
                 await asyncio.sleep(self.config.reconnect_delay)
 
-    async def close(self) -> None:
+    async def close(self, reconnecting: bool = False) -> None:
+        if self.config.fleet and not reconnecting and self.transport.connected:
+            # Clean goodbye: the registry drops our liveness now instead
+            # of aging it out, so the very next dispatch does not shard
+            # onto a corpse. The crash-reconnect path must NOT say goodbye
+            # — we are back within reconnect_delay, and a bye would churn
+            # a needless re-cover of our in-flight shards.
+            try:
+                await self._announce(bye=True)
+            except Exception:
+                pass
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

@@ -39,6 +39,20 @@ class ClientConfig:
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
     client_id: str = ""  # "" = auto: client-{payout[-8:]}-{hostname}
+    # -- fleet coordination (tpu_dpow/fleet/, docs/fleet.md) -----------
+    # Announce capabilities on fleet/announce and subscribe the private
+    # sharded-dispatch lane work/{type}/{worker_id}. Off => pure legacy
+    # racing worker (still fully served via the broadcast topics).
+    fleet: bool = True
+    # Re-announce (= fleet heartbeat) interval; the server's worker ttl
+    # defaults to 3x this.
+    fleet_announce_interval: float = 15.0
+    # Declared hashrate hint (H/s) for the planner's partition weights
+    # until measured wins build an EMA. 0 = unknown (floor weight).
+    declared_hashrate: float = 0.0
+    # Fleet identity; must be unique per worker process and topic-safe.
+    # "" = auto: derived from client_id (or payout + pid).
+    worker_id: str = ""
     log_file: Optional[str] = None
     # Persistent XLA compilation cache dir ("" = off). A restarted worker
     # reloads the launch-shape ladder's executables instead of re-paying
@@ -56,11 +70,24 @@ class ClientConfig:
             raise ValueError("--breaker_failures must be >= 1")
         if self.backend_hang_timeout < 0:
             raise ValueError("--backend_hang_timeout must be >= 0 (0 = off)")
+        if self.fleet_announce_interval <= 0:
+            raise ValueError("--fleet_announce_interval must be > 0")
         if self.payout_address:
             self.payout_address = self.payout_address.replace("xrb_", "nano_")
             nc.validate_account(self.payout_address)
         if isinstance(self.work_type, str):
             self.work_type = WorkType(self.work_type)
+
+    def resolve_worker_id(self) -> str:
+        """Topic-safe fleet identity: explicit > client_id-derived > auto."""
+        import os
+        import socket
+
+        raw = self.worker_id or self.client_id
+        if not raw:
+            tail = self.payout_address[-8:] if self.payout_address else "anon"
+            raw = f"w-{tail}-{socket.gethostname()}-{os.getpid()}"
+        return "".join(c if c not in "/+#" else "-" for c in raw)
 
 
 def parse_args(argv=None) -> ClientConfig:
@@ -129,6 +156,23 @@ def parse_args(argv=None) -> ClientConfig:
                    "(default payout+hostname — set explicitly when running "
                    "several workers on one machine, or they take over each "
                    "other's session)")
+    p.add_argument("--no_fleet", dest="fleet", action="store_false",
+                   help="don't announce to the fleet registry or subscribe "
+                   "the sharded-dispatch lane; behave as a pure legacy "
+                   "racing worker")
+    p.add_argument("--fleet_announce_interval", type=float,
+                   default=c.fleet_announce_interval,
+                   help="seconds between capability announces (the fleet "
+                   "heartbeat; the server ages workers out after its "
+                   "--fleet_worker_ttl without one)")
+    p.add_argument("--declared_hashrate", type=float,
+                   default=c.declared_hashrate,
+                   help="declared engine hashrate in H/s — the planner's "
+                   "partition weight until measured wins build an EMA "
+                   "(0 = unknown)")
+    p.add_argument("--worker_id", default=c.worker_id,
+                   help="fleet identity (topic-safe, unique per process; "
+                   "default derives from --client_id)")
     p.add_argument("--log_file", default=None)
     p.add_argument("--compilation_cache", default=c.compilation_cache,
                    help="persistent XLA compilation cache dir: a restarted "

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import random
 import traceback
+from dataclasses import replace
 from typing import Awaitable, Callable, Dict, Optional, Set
 
 from .. import obs
@@ -135,14 +136,15 @@ class WorkHandler:
         self.ongoing: Dict[str, _OngoingJob] = {}
         self._workers: list = []
         self._started = False
-        self.stats = {"queued": 0, "deduped": 0, "solved": 0, "cancelled": 0, "errors": 0}
+        self.stats = {"queued": 0, "deduped": 0, "solved": 0, "cancelled": 0,
+                      "errors": 0, "recovered": 0}
         # Registry mirrors of the stats dict plus the two depth gauges the
         # dict cannot express (current queue/ongoing, not lifetime counts).
         reg = obs.get_registry()
         self._m_events = reg.counter(
             "dpow_client_work_total",
             "Work-handler lifecycle events (queued/deduped/solved/"
-            "cancelled/errors)", ("event",))
+            "cancelled/errors/recovered)", ("event",))
         self._m_queue_depth = reg.gauge(
             "dpow_client_queue_depth", "Work items waiting for a worker slot")
         self._m_ongoing = reg.gauge(
@@ -189,7 +191,23 @@ class WorkHandler:
         if job is not None:
             if request.difficulty > job.request.difficulty:
                 if await self.backend.raise_difficulty(bh, request.difficulty):
-                    # The await may have yielded; only relabel if the SAME
+                    if (
+                        request.nonce_range is not None
+                        and request.nonce_range != job.request.nonce_range
+                        and not await self.backend.cover_range(
+                            bh, request.nonce_range
+                        )
+                    ):
+                        # A raised re-target may also re-shard (the server
+                        # re-plans at the new difficulty). If the engine
+                        # could not rebase, the job must keep its OLD range
+                        # label — recording the new one would make future
+                        # re-publishes of that shard dedup as "already
+                        # covered" while nothing scans it.
+                        request = replace(
+                            request, nonce_range=job.request.nonce_range
+                        )
+                    # The awaits may have yielded; only relabel if the SAME
                     # job is still ongoing — writing after the worker loop
                     # popped it would mislabel a successor job.
                     if self.ongoing.get(bh) is job:
@@ -199,6 +217,21 @@ class WorkHandler:
                     self.queue.put(request)
                     self._bump("queued")
                     return
+            elif (
+                request.nonce_range is not None
+                and request.nonce_range != job.request.nonce_range
+            ):
+                # Fleet re-cover (docs/fleet.md): a duplicate carrying a
+                # DIFFERENT shard means the server handed us a dead
+                # worker's range for the hash we are already scanning.
+                # Rebase the running job onto the orphaned shard; engines
+                # that cannot rebase drop the hint (their scan is already
+                # correct, just not where the server asked).
+                if await self.backend.cover_range(bh, request.nonce_range):
+                    if self.ongoing.get(bh) is job:
+                        job.request = request
+                    self._bump("recovered")
+                    return
             self._bump("deduped")
             return
         queued = self.queue.get(bh)
@@ -206,7 +239,20 @@ class WorkHandler:
             if request.difficulty > queued.difficulty:
                 self.queue.replace(request)
                 logger.debug("raised queued difficulty for %s", bh)
-            self._bump("deduped")
+                self._bump("deduped")
+            elif (
+                request.nonce_range is not None
+                and request.nonce_range != queued.nonce_range
+            ):
+                # Re-cover before the job even started (all worker slots
+                # busy): take the new shard in place — nothing has scanned
+                # the old one yet, and the server's cover table already
+                # records us on the new range. Symmetric with the
+                # ongoing-job rebase above.
+                self.queue.replace(request)
+                self._bump("recovered")
+            else:
+                self._bump("deduped")
             return
         self.queue.put(request)
         self._bump("queued")

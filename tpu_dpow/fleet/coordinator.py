@@ -1,0 +1,259 @@
+"""FleetCoordinator: the one object the server's publish paths talk to.
+
+Every work publish the orchestrator used to aim at the shared broadcast
+topic now goes through here. Per dispatch the coordinator asks the planner
+for a plan and either
+
+  * SHARDED — one ranged payload per selected worker on that worker's
+    private lane ``work/{type}/{worker_id}`` (fleet-aware clients subscribe
+    their lane next to the broadcast topic; the nonce range rides the
+    payload as the backward-compatible trailing field,
+    transport/mqtt_codec.py), with the assignment table registered in the
+    coverage tracker; or
+  * BROADCAST — the reference's racing behavior on ``work/{type}``,
+    whenever the registry is empty/stale/too small (planner fallback) or
+    fleet mode is off.
+
+The resilience supervisor's republish callback also lands here: a silent
+SHARDED dispatch is healed shard-wise — live owners get their own shard
+re-published (lost QoS-0 publish), dead owners' shards are handed to live
+workers (planner.reassign) or, with nobody live to take them, broadcast as
+ranged payloads any racer (including a legacy, range-ignoring client) can
+pick up. A HEDGED escalation abandons coordination for the dispatch and
+falls back to the full-space broadcast on both work topics — by that point
+sharding has failed twice and raw redundancy is the right tool.
+
+Metric accounting is exhaustive: every dispatch increments exactly one
+``dpow_fleet_dispatch_total{mode=...}`` series, and the planned-redundancy
+gauge tracks how many workers the last dispatch set racing (1 shard = 1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import obs
+from ..transport import QOS_0
+from ..transport.mqtt_codec import encode_work_payload
+from ..utils.logging import get_logger
+from .cover import BROADCAST_OWNER, CoverageTracker
+from .planner import BROADCAST, SHARDED, FleetPlanner, Plan
+from .registry import WorkerRegistry
+
+logger = get_logger("tpu_dpow.fleet")
+
+#: Topic fleet-aware clients announce on (QoS 1; server subscribes fleet/#).
+ANNOUNCE_TOPIC = "fleet/announce"
+
+
+def work_topic(work_type: str, worker_id: Optional[str] = None) -> str:
+    """Shared broadcast topic, or a worker's private sharded-dispatch lane."""
+    return f"work/{work_type}/{worker_id}" if worker_id else f"work/{work_type}"
+
+
+class FleetCoordinator:
+    def __init__(
+        self,
+        registry: WorkerRegistry,
+        planner: FleetPlanner,
+        cover: CoverageTracker,
+        transport,
+        *,
+        clock,
+        enabled: bool = True,
+    ):
+        self.registry = registry
+        self.planner = planner
+        self.cover = cover
+        self.transport = transport
+        self.clock = clock
+        self.enabled = enabled
+        reg = obs.get_registry()
+        self._m_dispatch = reg.counter(
+            "dpow_fleet_dispatch_total",
+            "Work dispatches, by delivery mode", ("mode",))
+        self._m_recovered = reg.counter(
+            "dpow_fleet_ranges_recovered_total",
+            "Shards re-covered after their worker died or went silent")
+        self._m_redundancy = reg.gauge(
+            "dpow_fleet_redundancy_ratio",
+            "Workers racing the most recent dispatch (sharded = 1 per "
+            "nonce, broadcast = the whole registered fleet)")
+
+    # -- dispatch ------------------------------------------------------
+
+    async def publish_work(
+        self,
+        block_hash: str,
+        difficulty: int,
+        work_type: str,
+        trace_id: Optional[str] = None,
+    ) -> str:
+        """Publish one dispatch; returns the mode used ('sharded' |
+        'broadcast'). Counts every call in dpow_fleet_dispatch_total."""
+        plan = self.planner.plan(difficulty, work_type) if self.enabled else Plan(
+            mode=BROADCAST, racers=1
+        )
+        if plan.mode == SHARDED:
+            for a in plan.assignments:
+                await self.transport.publish(
+                    work_topic(work_type, a.worker_id),
+                    encode_work_payload(
+                        block_hash, difficulty, trace_id,
+                        (a.start, a.length),
+                    ),
+                    qos=QOS_0,
+                )
+            self.cover.begin(
+                block_hash, work_type, difficulty, plan.assignments,
+                self.clock.time(),
+            )
+            self._m_dispatch.inc(1, SHARDED)
+            # Disjoint shards: exactly one worker per nonce.
+            self._m_redundancy.set(1.0)
+            logger.debug(
+                "sharded %s across %d workers", block_hash, len(plan.assignments)
+            )
+        else:
+            await self.transport.publish(
+                work_topic(work_type),
+                encode_work_payload(block_hash, difficulty, trace_id),
+                qos=QOS_0,
+            )
+            self.cover.forget(block_hash)  # a re-target may downgrade modes
+            self._m_dispatch.inc(1, BROADCAST)
+            self._m_redundancy.set(float(max(plan.racers, 1)))
+        return plan.mode
+
+    # -- supervisor republish path -------------------------------------
+
+    async def republish(
+        self,
+        block_hash: str,
+        difficulty: int,
+        work_type: str,
+        hedged: bool,
+        trace_id: Optional[str] = None,
+    ) -> bool:
+        """Heal a silent dispatch; returns True iff something was
+        published (the supervisor re-arms its grace window only then)."""
+        if hedged or not self.cover.tracked(block_hash):
+            # Escalation (or a broadcast dispatch): raw redundancy. The
+            # hedged fan-out recruits the secondary topic's pool exactly as
+            # the pre-fleet supervisor did; coordination is abandoned so a
+            # later winner is not mis-attributed to a stale shard table.
+            self.cover.forget(block_hash)
+            payload = encode_work_payload(block_hash, difficulty, trace_id)
+            await self.transport.publish(work_topic(work_type), payload, qos=QOS_0)
+            if hedged:
+                other = "precache" if work_type == "ondemand" else "ondemand"
+                await self.transport.publish(work_topic(other), payload, qos=QOS_0)
+            return True
+        plan = self.cover.republish_plan(block_hash)
+        if plan is None:
+            return False
+        lane, orphaned, rebroadcast = plan
+        now = self.clock.time()
+        published = False
+        for a in lane:
+            # Freshest shard per live owner, to its own lane: the original
+            # QoS-0 publish may have fired mid-reconnect. A re-send of the
+            # range the client already scans dedups clean (no rebase).
+            await self.transport.publish(
+                work_topic(work_type, a.worker_id),
+                encode_work_payload(
+                    block_hash, difficulty, trace_id, (a.start, a.length)
+                ),
+                qos=QOS_0,
+            )
+            published = True
+        # Reassignment prefers workers with no shard of this dispatch yet:
+        # handing a second shard to a current assignee rebases its single
+        # running job away from its own shard.
+        taken = self.cover.current_owners(block_hash)
+        for a in orphaned:
+            replacement = self.planner.reassign(
+                a, exclude=taken, work_type=work_type
+            ) or self.planner.reassign(a, work_type=work_type)
+            if replacement is not None:
+                taken.add(replacement.worker_id)
+                await self.transport.publish(
+                    work_topic(work_type, replacement.worker_id),
+                    encode_work_payload(
+                        block_hash, difficulty, trace_id,
+                        (replacement.start, replacement.length),
+                    ),
+                    qos=QOS_0,
+                )
+                self.cover.reassigned(block_hash, a, replacement.worker_id, now)
+                logger.info(
+                    "re-covered shard [%016x+%016x] of %s: %s -> %s",
+                    a.start, a.length, block_hash, a.worker_id,
+                    replacement.worker_id,
+                )
+            else:
+                # Nobody live to take it: broadcast the RANGED payload —
+                # fleet clients honor the range, a legacy client ignores it
+                # and races the full space (correct either way). Marked in
+                # the cover table so later fires re-broadcast WITHOUT
+                # re-counting the same shard as freshly re-covered.
+                await self.transport.publish(
+                    work_topic(work_type),
+                    encode_work_payload(
+                        block_hash, difficulty, trace_id, (a.start, a.length)
+                    ),
+                    qos=QOS_0,
+                )
+                self.cover.reassigned(
+                    block_hash, a, BROADCAST_OWNER, now
+                )
+                logger.info(
+                    "broadcast orphaned shard [%016x+%016x] of %s (no live "
+                    "worker to reassign)", a.start, a.length, block_hash,
+                )
+            self._m_recovered.inc()
+            published = True
+        for a in rebroadcast:
+            await self.transport.publish(
+                work_topic(work_type),
+                encode_work_payload(
+                    block_hash, difficulty, trace_id, (a.start, a.length)
+                ),
+                qos=QOS_0,
+            )
+            published = True
+        return published
+
+    # -- result / teardown hooks ---------------------------------------
+
+    async def on_announce(self, payload: str) -> None:
+        await self.registry.handle_announce(payload)
+
+    async def on_winner(self, block_hash: str, work: str) -> None:
+        """Attribute a winning result to its shard: EMA throughput sample
+        + liveness touch for the owning worker."""
+        try:
+            nonce = int(work, 16)
+        except ValueError:
+            return
+        sample = self.cover.resolve(block_hash, nonce, self.clock.time())
+        if sample is None:
+            return
+        worker_id, hashes, elapsed = sample
+        if not self.registry.is_live(worker_id):
+            # The shard's recorded owner is dead — its orphaned range was
+            # broadcast (no live replacement) and whoever actually solved
+            # it is unknown. Attributing the win would RESURRECT the dead
+            # worker (touch stamps it live, the next plan shards onto a
+            # lane nobody subscribes) and feed its EMA a bogus sample.
+            return
+        self.registry.touch(worker_id)
+        ema = await self.registry.observe_result(worker_id, hashes, elapsed)
+        if ema is not None:
+            logger.debug(
+                "attributed win on %s to %s (%.3g H over %.3gs; ema %.3g H/s)",
+                block_hash, worker_id, hashes, elapsed, ema,
+            )
+
+    def forget(self, block_hash: str) -> None:
+        self.cover.forget(block_hash)

@@ -31,16 +31,31 @@ class WorkType(str, enum.Enum):
 
 @dataclass(frozen=True)
 class WorkRequest:
-    """One unit of searchable work: a block hash at a difficulty."""
+    """One unit of searchable work: a block hash at a difficulty.
+
+    ``nonce_range`` is the fleet planner's sharded-dispatch assignment
+    (tpu_dpow.fleet): ``(start, length)`` with length 0 meaning the full
+    2^64 span. It is a SOFT hint — a range-aware engine starts its scan at
+    ``start`` (disjoint from every other worker's shard instead of a random
+    decorrelating base) and may scan past the end rather than stall a
+    dispatch whose shard happens to hold no solution; a legacy engine
+    ignores it entirely and races the full space, which is always correct.
+    """
 
     block_hash: str  # 64 uppercase hex chars
     difficulty: int  # u64 threshold
     work_type: WorkType = WorkType.ONDEMAND
+    nonce_range: Optional[tuple] = None  # (start u64, length u64; 0 = 2^64)
 
     def __post_init__(self):
         object.__setattr__(self, "block_hash", nc.validate_block_hash(self.block_hash))
         if not (0 < self.difficulty <= nc.MAX_U64):
             raise nc.InvalidDifficulty(f"difficulty out of range: {self.difficulty}")
+        if self.nonce_range is not None:
+            start, length = self.nonce_range
+            if not (0 <= start <= nc.MAX_U64) or not (0 <= length <= nc.MAX_U64):
+                raise ValueError(f"nonce range out of u64: {self.nonce_range}")
+            object.__setattr__(self, "nonce_range", (int(start), int(length)))
 
     @property
     def difficulty_hex(self) -> str:

@@ -215,6 +215,175 @@ async def scenario() -> dict:
     }
 
 
+FLEET_FAMILIES = (
+    "dpow_fleet_workers_live",
+    "dpow_fleet_workers_registered",
+    "dpow_fleet_hashrate_hs",
+    "dpow_fleet_announces_total",
+    "dpow_fleet_dispatch_total",
+    "dpow_fleet_ranges_recovered_total",
+    "dpow_fleet_redundancy_ratio",
+)
+
+
+class _ParkedBackend(WorkBackend):
+    """Backend the fleet scenario drives by hand: records the assigned
+    shard, solves only when the script says so (honoring the range the
+    way the jax/native engines do — scan upward from the shard start)."""
+
+    def __init__(self):
+        self.requests = {}
+        self.futures = {}
+        self.covered = {}
+
+    async def setup(self):
+        pass
+
+    async def generate(self, request):
+        self.requests[request.block_hash] = request
+        fut = asyncio.get_running_loop().create_future()
+        self.futures[request.block_hash] = fut
+        return await fut
+
+    async def cancel(self, block_hash):
+        fut = self.futures.get(block_hash)
+        if fut and not fut.done():
+            from ..backend import WorkCancelled
+
+            fut.set_exception(WorkCancelled(block_hash))
+
+    async def cover_range(self, block_hash, nonce_range):
+        if block_hash not in self.futures or self.futures[block_hash].done():
+            return False
+        self.covered[block_hash] = nonce_range
+        return True
+
+    def solve_from(self, block_hash, difficulty, start):
+        h = bytes.fromhex(block_hash)
+        w = start
+        while True:
+            v = int.from_bytes(
+                hashlib.blake2b(
+                    struct.pack("<Q", w & ((1 << 64) - 1)) + h, digest_size=8
+                ).digest(),
+                "little",
+            )
+            if v >= difficulty:
+                break
+            w += 1
+        work = f"{w & ((1 << 64) - 1):016x}"
+        self.futures[block_hash].set_result(work)
+        return work
+
+
+async def fleet_scenario() -> dict:
+    """Fleet coordination end to end (docs/fleet.md): three workers join
+    and announce, a dispatch shards the nonce space across them, one
+    worker is killed mid-range, the supervisor's grace window hands the
+    orphaned shard to a live worker, and the result lands — attributed to
+    the re-covering worker's hashrate EMA. FakeClock: the worker ttl and
+    grace windows play out in milliseconds."""
+    obs.reset()
+    clock = FakeClock()
+    broker = Broker()
+    store = MemoryStore()
+    config = ServerConfig(
+        base_difficulty=EASY, throttle=1000.0, heartbeat_interval=0.05,
+        statistics_interval=3600.0, work_republish_interval=2.0,
+        hedge_after=10, fleet_worker_ttl=5.0,
+    )
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"), clock=clock
+    )
+    await server.setup()
+    server.start_loops()
+    await store.hset("service:demo", {"api_key": hash_key("demo"),
+                                      "public": "N", "precache": "0",
+                                      "ondemand": "0"})
+    await store.sadd("services", "demo")
+
+    log: list = []
+    clients = []
+    for i, rate in enumerate((1e6, 2e6, 4e6), 1):
+        c = DpowClient(
+            ClientConfig(payout_address=PAYOUT, startup_heartbeat_wait=3.0,
+                         worker_id=f"fleet-w{i}", declared_hashrate=rate,
+                         fleet_announce_interval=3600.0),
+            InProcTransport(broker, client_id=f"fleet-w{i}",
+                            clean_session=False),
+            backend=_ParkedBackend(),
+        )
+        await c.setup()
+        c.start_loops()
+        clients.append(c)
+    try:
+        await _settle()
+        live = server.fleet_registry.live_workers("ondemand")
+        log.append(f"{len(live)} workers announced "
+                   f"({', '.join(i.worker_id for i in live)}); registry live")
+
+        h = f"{9:064X}"
+        req = asyncio.ensure_future(server.service_handler(
+            {"user": "demo", "api_key": "demo", "hash": h, "timeout": 25}
+        ))
+        await _settle()
+        shards = {
+            c.worker_id: c.work_handler.backend.requests[h].nonce_range
+            for c in clients
+        }
+        log.append("dispatch SHARDED: " + "; ".join(
+            f"{w} [{s:016x}+{ln:016x}]" for w, (s, ln) in shards.items()))
+
+        victim = clients[2]  # the fastest worker owns the widest shard
+        victim.config.fleet = False  # die silently — no goodbye
+        await victim.close()
+        log.append(f"{victim.worker_id} KILLED mid-range (no goodbye)")
+        for _ in range(2):  # survivors keep announcing while victim ages out
+            await clock.advance(2.0)
+            for c in clients[:2]:
+                await c._announce()
+            await _settle()
+        await clock.advance(2.0)
+        await _settle()
+        taker = next(
+            c for c in clients[:2]
+            if c.work_handler.backend.covered.get(h) is not None
+        )
+        log.append(
+            f"supervisor grace fired: {victim.worker_id}'s shard re-covered "
+            f"onto {taker.worker_id} "
+            f"(ranges_recovered_total="
+            f"{int(obs.get_registry().counter('dpow_fleet_ranges_recovered_total').value())})"
+        )
+        await clock.advance(0.5)
+        start = shards[victim.worker_id][0]
+        work = taker.work_handler.backend.solve_from(h, EASY, start)
+        resp = await asyncio.wait_for(req, 10)
+        assert resp["work"] == work
+        nc.validate_work(h, work, EASY)
+        await _settle()
+        ema = server.fleet_registry.get(taker.worker_id).ema_hashrate
+        log.append(f"result landed from the orphaned shard; win attributed "
+                   f"to {taker.worker_id} (measured EMA {ema:.3g} H/s)")
+    finally:
+        for c in clients:
+            if c.transport.connected:
+                await c.close()
+        await server.close()
+
+    snapshot = obs.snapshot()
+    return {
+        "narrative": log,
+        "metrics": {
+            name: snapshot[name] for name in FLEET_FAMILIES
+            if name in snapshot
+        },
+        "recovered_ranges": snapshot[
+            "dpow_fleet_ranges_recovered_total"]["series"][""],
+        "result_landed": True,
+    }
+
+
 def main() -> int:
     result = asyncio.run(asyncio.wait_for(scenario(), timeout=60))
     print("=== chaos demo: drop / fail / recover ===")
@@ -229,7 +398,18 @@ def main() -> int:
     print(f"\nscenario {'completed' if ok else 'FAILED'}: every request "
           f"served through dropped publishes, a tripped engine and a store "
           f"outage")
-    return 0 if ok else 1
+
+    fleet = asyncio.run(asyncio.wait_for(fleet_scenario(), timeout=60))
+    print("\n=== chaos demo: fleet join / shard / kill / re-cover ===")
+    for line in fleet["narrative"]:
+        print(f"  * {line}")
+    print("\n=== obs snapshot (fleet families) ===")
+    print(json.dumps(fleet["metrics"], indent=2, sort_keys=True))
+    fleet_ok = fleet["result_landed"] and fleet["recovered_ranges"] >= 1
+    print(f"\nfleet scenario {'completed' if fleet_ok else 'FAILED'}: "
+          f"sharded dispatch survived a mid-range worker death via "
+          f"re-cover")
+    return 0 if (ok and fleet_ok) else 1
 
 
 if __name__ == "__main__":

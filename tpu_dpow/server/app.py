@@ -30,12 +30,19 @@ import traceback
 from typing import Dict, Optional
 
 from .. import obs
+from ..fleet import (
+    ANNOUNCE_TOPIC,
+    CoverageTracker,
+    FleetCoordinator,
+    FleetPlanner,
+    WorkerRegistry,
+)
 from ..models import DifficultyModel, WorkType
 from ..resilience import DispatchSupervisor, SystemClock
 from ..sched import AdmissionController
 from ..store import MemoryStore, Store
 from ..transport import Message, QOS_0, QOS_1, Transport
-from ..transport.mqtt_codec import encode_work_payload, parse_result_payload
+from ..transport.mqtt_codec import parse_result_payload
 from ..utils import nanocrypto as nc
 from ..utils.logging import get_logger
 from ..utils.throttle import Throttler
@@ -122,6 +129,29 @@ class DpowServer:
         # Window ticket per dispatched hash; lives and dies with the
         # work_futures entry (released in _drop_dispatch_state).
         self._dispatch_tickets: Dict[str, object] = {}
+        # Fleet coordination (tpu_dpow/fleet/): every work publish routes
+        # through the coordinator, which shards the nonce space across the
+        # announced worker fleet (disjoint hashrate-weighted ranges) and
+        # falls back to the reference's broadcast race whenever the
+        # registry is empty, stale, or below fleet_min_workers. The
+        # supervisor's republish heals sharded dispatches shard-wise
+        # (docs/fleet.md).
+        self.fleet_registry = WorkerRegistry(
+            store, clock=self.clock, ttl=config.fleet_worker_ttl
+        )
+        self.fleet = FleetCoordinator(
+            self.fleet_registry,
+            FleetPlanner(
+                self.fleet_registry,
+                min_workers=config.fleet_min_workers,
+                max_shards=config.fleet_max_shards,
+                horizon=config.fleet_horizon,
+            ),
+            CoverageTracker(self.fleet_registry),
+            transport,
+            clock=self.clock,
+            enabled=config.fleet,
+        )
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
         self.work_republished = 0  # healed lost publishes (observability)
@@ -172,6 +202,15 @@ class DpowServer:
         await self.transport.connect()
         # Server consumes results; everything else it publishes.
         await self.transport.subscribe("result/#", qos=QOS_0)
+        if self.config.fleet:
+            # Fleet announces ride QoS 1 so a worker's join survives a
+            # server blip. With --no_fleet the subscription is skipped
+            # entirely: announces from fleet-default clients must not cost
+            # registry/store work on a server that will never shard.
+            await self.transport.subscribe("fleet/#", qos=QOS_1)
+            # Rehydrate fleet capabilities (learned hashrates) from the
+            # store; liveness restarts with one ttl of announce grace.
+            await self.fleet_registry.load()
         self._started = True
 
     def start_loops(self) -> None:
@@ -187,6 +226,8 @@ class DpowServer:
                 self.admission.run(self.config.admission_poll_interval)
             )
         )
+        if self.config.fleet:
+            self._tasks.append(asyncio.ensure_future(self._fleet_poll_loop()))
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
 
@@ -211,6 +252,8 @@ class DpowServer:
             try:
                 if msg.topic.startswith("result/"):
                     await self.client_result_handler(msg.topic, msg.payload)
+                elif msg.topic == ANNOUNCE_TOPIC and self.config.fleet:
+                    await self.fleet.on_announce(msg.payload)
             except Exception:
                 logger.error("result handling failed:\n%s", traceback.format_exc())
 
@@ -273,12 +316,18 @@ class DpowServer:
         difficulty = self._dispatched_difficulty.get(
             block_hash, self.config.base_difficulty
         )
-        payload = encode_work_payload(
-            block_hash, difficulty, self._tracer.id_for(block_hash)
+        # Fleet-aware heal (fleet/coordinator.py): a SHARDED dispatch gets
+        # shard-wise recovery — live owners' shards re-published to their
+        # lanes, dead owners' shards handed to live workers — instead of
+        # re-racing the whole fleet over the full space. Broadcast
+        # dispatches (and hedged escalations, which abandon coordination)
+        # republish exactly as before.
+        published = await self.fleet.republish(
+            block_hash, difficulty, WorkType.ONDEMAND.value, hedged,
+            self._tracer.id_for(block_hash),
         )
-        await self.transport.publish("work/ondemand", payload, qos=QOS_0)
-        if hedged:
-            await self.transport.publish("work/precache", payload, qos=QOS_0)
+        if not published:
+            return False
         self.work_republished += 1
         self._m_republished.inc()
         logger.info(
@@ -286,6 +335,22 @@ class DpowServer:
             block_hash, " (hedged)" if hedged else "",
         )
         return True
+
+    async def _fleet_poll_loop(self) -> None:
+        """Fleet hygiene on the injectable clock: long-dead workers are
+        dropped, the live/hashrate gauges resync even while nothing flows,
+        and abandoned shard tables (a precache dispatch whose result was
+        lost AND whose account never confirms again has no other teardown
+        path) are swept out."""
+        cover_age = max(self.config.precache_lease * 4,
+                        self.config.max_timeout * 2)
+        while True:
+            await self.clock.sleep(max(self.config.fleet_worker_ttl / 2, 0.5))
+            try:
+                await self.fleet_registry.poll()
+                self.fleet.cover.sweep(self.clock.time(), cover_age)
+            except Exception as e:
+                logger.warning("fleet registry sweep failed: %s", e)
 
     async def _checkpoint_loop(self) -> None:
         while True:
@@ -391,6 +456,11 @@ class DpowServer:
             return
 
         self._m_results.inc(1, "winner")
+        # Fleet attribution BEFORE the cover is torn down: the winning
+        # nonce identifies the shard (disjoint ranges), and nonce - start
+        # over the dispatch elapsed is the worker's EMA throughput sample.
+        await self.fleet.on_winner(block_hash, work)
+        self.fleet.forget(block_hash)
         if trace_id is not None:
             # Bind the worker-echoed trace id so winner/cancel marks land
             # even if this server never began the trace (restart
@@ -494,12 +564,9 @@ class DpowServer:
             self.store.set(
                 f"work-type:{block_hash}", WorkType.PRECACHE.value, expire=self.config.block_expiry
             ),
-            self.transport.publish(
-                "work/precache",
-                encode_work_payload(
-                    block_hash, self.config.base_difficulty, trace_id
-                ),
-                qos=QOS_0,
+            self.fleet.publish_work(
+                block_hash, self.config.base_difficulty,
+                WorkType.PRECACHE.value, trace_id,
             ),
         ]
         if old_frontier:
@@ -512,6 +579,7 @@ class DpowServer:
             # here the retirement is made atomic instead). A retired hash
             # will never see its result: its precache lease goes with it.
             self.admission.release_key(old_frontier)
+            self.fleet.forget(old_frontier)
             aws.append(
                 self.store.delete(
                     f"block:{old_frontier}",
@@ -521,6 +589,7 @@ class DpowServer:
             )
         elif previous_exists:
             self.admission.release_key(previous)
+            self.fleet.forget(previous)
             aws.append(
                 self.store.delete(
                     f"block:{previous}",
@@ -560,6 +629,7 @@ class DpowServer:
         self._dispatched_difficulty.pop(block_hash, None)
         self._difficulty_locks.pop(block_hash, None)
         self.supervisor.untrack(block_hash)
+        self.fleet.forget(block_hash)
         ticket = self._dispatch_tickets.pop(block_hash, None)
         if ticket is not None:
             self.admission.release(ticket)
@@ -804,12 +874,11 @@ class DpowServer:
                     # worker arriving between the two publishes would
                     # otherwise grind at a target the result handler no
                     # longer accepts — with nothing left to re-publish.
-                    await self.transport.publish(
-                        "work/ondemand",
-                        encode_work_payload(
-                            block_hash, effective, self._tracer.id_for(block_hash)
-                        ),
-                        qos=QOS_0,
+                    # Routed through the fleet coordinator: sharded across
+                    # the announced fleet or broadcast (registry too small).
+                    await self.fleet.publish_work(
+                        block_hash, effective, WorkType.ONDEMAND.value,
+                        self._tracer.id_for(block_hash),
                     )
                     self.supervisor.dispatched(block_hash)
                     self._tracer.mark_hash(block_hash, "publish")
@@ -879,14 +948,14 @@ class DpowServer:
                                 f"{difficulty:016x}",
                                 expire=self.config.difficulty_expiry,
                             )
-                            await self.transport.publish(
-                                "work/ondemand",
-                                encode_work_payload(
-                                    block_hash,
-                                    difficulty,
-                                    self._tracer.id_for(block_hash),
-                                ),
-                                qos=QOS_0,
+                            # Re-plan at the raised target: the coordinator
+                            # replaces the dispatch's shard table, so
+                            # coverage and attribution follow the raise.
+                            await self.fleet.publish_work(
+                                block_hash,
+                                difficulty,
+                                WorkType.ONDEMAND.value,
+                                self._tracer.id_for(block_hash),
                             )
                         except BaseException:
                             self._dispatched_difficulty[block_hash] = current

@@ -86,6 +86,22 @@ class ServerConfig:
     # Retry-After hint (seconds) carried by shed/rejected responses.
     busy_retry_after: float = 1.0
     admission_poll_interval: float = 0.5
+    # -- fleet coordination (tpu_dpow/fleet/, docs/fleet.md) -----------
+    # Sharded dispatch: partition the nonce space across announced workers
+    # instead of broadcast-racing them. Off => pure reference behavior.
+    fleet: bool = True
+    # Below this many live announced workers every dispatch broadcasts
+    # (sharding a one-worker "fleet" only adds bookkeeping).
+    fleet_min_workers: int = 2
+    # A worker with no announce for this long is no longer live; its
+    # shards are re-covered. Clients announce every fleet_announce_interval
+    # (client config, default 15 s), so 3 missed announces = dead.
+    fleet_worker_ttl: float = 45.0
+    fleet_max_shards: int = 64
+    # Right-sizing: > 0 selects just enough workers per dispatch to cover
+    # the expected solve within this many seconds, leaving the rest free
+    # for concurrent dispatches. 0 = the whole live fleet every time.
+    fleet_horizon: float = 0.0
     log_file: Optional[str] = None
 
 
@@ -143,6 +159,22 @@ def parse_args(argv=None) -> ServerConfig:
                    default=c.admission_poll_interval,
                    help="seconds between admission sweeps (lapsed precache "
                    "leases, deadline-expired queued waiters)")
+    p.add_argument("--no_fleet", dest="fleet", action="store_false",
+                   help="disable sharded fleet dispatch; every work "
+                   "publish broadcasts to the whole swarm (reference "
+                   "behavior)")
+    p.add_argument("--fleet_min_workers", type=int, default=c.fleet_min_workers,
+                   help="minimum live announced workers before dispatches "
+                   "shard instead of broadcast")
+    p.add_argument("--fleet_worker_ttl", type=float, default=c.fleet_worker_ttl,
+                   help="seconds without an announce before a worker's "
+                   "shards are re-covered onto the rest of the fleet")
+    p.add_argument("--fleet_max_shards", type=int, default=c.fleet_max_shards,
+                   help="cap on nonce-range shards per dispatch")
+    p.add_argument("--fleet_horizon", type=float, default=c.fleet_horizon,
+                   help="right-size each dispatch to the workers needed to "
+                   "cover the expected solve in this many seconds "
+                   "(0 = use the whole live fleet per dispatch)")
     p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
                    help="seconds between public statistics broadcasts "
                    "(reference: fixed 300)")

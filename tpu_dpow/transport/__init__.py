@@ -187,11 +187,15 @@ def default_users(server_password: str = "dpowserver", client_password: str = "c
         "dpowserver": User(
             password=server_password,
             acl_pub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#"),
-            acl_sub=("result/#",),
+            # fleet/#: worker capability announces (tpu_dpow.fleet) — an
+            # additive grant over the reference matrix.
+            acl_sub=("result/#", "fleet/#"),
         ),
         "client": User(
             password=client_password,
-            acl_pub=("result/#",),
+            acl_pub=("result/#", "fleet/announce"),
+            # work/# already covers the per-worker sharded-dispatch lanes
+            # (work/{type}/{worker_id}).
             acl_sub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#"),
         ),
         "dpowinterface": User(
@@ -202,7 +206,7 @@ def default_users(server_password: str = "dpowserver", client_password: str = "c
             # acls:22-31) — the latency probe subscribes work/result/cancel.
             acl_sub=(
                 "work/#", "cancel/#", "result/#",
-                "statistics", "client/#", "heartbeat",
+                "statistics", "client/#", "heartbeat", "fleet/#",
             ),
         ),
     }

@@ -18,6 +18,7 @@ Packet formats follow MQTT 3.1.1 (OASIS standard, §2-§3).
 from __future__ import annotations
 
 import asyncio
+import re
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -320,14 +321,19 @@ def decode(first_byte: int, body: bytes):
 # -- dpow data-plane payload helpers ---------------------------------------
 #
 # The topic contract's comma-separated payloads (docs/specification.md:
-# work = "hash,difficulty", result = "hash,work,client") gain ONE optional
-# trailing field: a 16-hex trace id stamping the request through the
-# pipeline (tpu_dpow.obs.trace). Encoding/parsing lives here, next to the
-# wire format it extends, so every face (server, client, probes) agrees on
-# the grammar. Backward/forward compatible by construction: absent field =>
-# None; a peer that predates tracing parses the leading fields unchanged
-# and an unrecognized trailing token is ignored rather than rejected —
-# the MQTT packet encoding above is untouched (byte goldens hold).
+# work = "hash,difficulty", result = "hash,work,client") gain OPTIONAL
+# trailing fields: a 16-hex trace id stamping the request through the
+# pipeline (tpu_dpow.obs.trace, PR 1), and — on work messages only — a
+# nonce-range assignment "start+length" (two 16-hex words joined by '+',
+# tpu_dpow.fleet sharded dispatch). Encoding/parsing lives here, next to
+# the wire format it extends, so every face (server, client, probes) agrees
+# on the grammar. Backward/forward compatible by construction: absent
+# fields => None; a peer that predates tracing/sharding parses the leading
+# fields unchanged and an unrecognized trailing token is ignored rather
+# than rejected — the MQTT packet encoding above is untouched (byte
+# goldens hold), and range-free payloads are byte-identical to pre-fleet
+# ones. The two trailing tokens are distinguishable by shape alone (16 hex
+# chars vs 33 chars with a '+'), so their order on the wire is free.
 
 
 def _opt_trace(fields: List[str], at: int) -> Optional[str]:
@@ -338,20 +344,62 @@ def _opt_trace(fields: List[str], at: int) -> Optional[str]:
     return None
 
 
+#: A nonce-range token: start and length as 16-hex u64 words joined by '+'.
+#: length 0 encodes the full 2^64 space (a 2^64 span does not fit a u64).
+_RANGE_RE = re.compile(r"^([0-9a-f]{16})\+([0-9a-f]{16})$")
+
+#: (start, length) with length == 0 meaning the full 2^64 span.
+NonceRange = Tuple[int, int]
+
+
+def encode_nonce_range(nonce_range: NonceRange) -> str:
+    start, length = nonce_range
+    if not (0 <= start < 1 << 64) or not (0 <= length < 1 << 64):
+        raise ValueError(f"nonce range out of u64: {nonce_range}")
+    return f"{start:016x}+{length:016x}"
+
+
+def parse_nonce_range(token: str) -> Optional[NonceRange]:
+    m = _RANGE_RE.match(token)
+    if m is None:
+        return None
+    return int(m.group(1), 16), int(m.group(2), 16)
+
+
 def encode_work_payload(
-    block_hash: str, difficulty: int, trace_id: Optional[str] = None
+    block_hash: str,
+    difficulty: int,
+    trace_id: Optional[str] = None,
+    nonce_range: Optional[NonceRange] = None,
 ) -> str:
     base = f"{block_hash},{difficulty:016x}"
-    return f"{base},{trace_id}" if trace_id else base
+    if trace_id:
+        base = f"{base},{trace_id}"
+    if nonce_range is not None:
+        base = f"{base},{encode_nonce_range(nonce_range)}"
+    return base
 
 
-def parse_work_payload(payload: str) -> Tuple[str, str, Optional[str]]:
-    """-> (block_hash, difficulty_hex, trace_id or None). Raises ValueError
-    on fewer than two fields (the pre-trace contract's minimum)."""
+def parse_work_payload(
+    payload: str,
+) -> Tuple[str, str, Optional[str], Optional[NonceRange]]:
+    """-> (block_hash, difficulty_hex, trace_id or None, nonce_range or
+    None). Raises ValueError on fewer than two fields (the pre-trace
+    contract's minimum). Trailing tokens that are neither a trace id nor a
+    range are ignored (forward compatibility, same policy as PR 1)."""
     fields = payload.split(",")
     if len(fields) < 2:
         raise ValueError(f"work payload needs hash,difficulty: {payload!r}")
-    return fields[0], fields[1], _opt_trace(fields, 2)
+    from ..obs.trace import is_trace_id
+
+    trace_id: Optional[str] = None
+    nonce_range: Optional[NonceRange] = None
+    for token in fields[2:]:
+        if trace_id is None and is_trace_id(token):
+            trace_id = token
+        elif nonce_range is None:
+            nonce_range = parse_nonce_range(token)
+    return fields[0], fields[1], trace_id, nonce_range
 
 
 def encode_result_payload(
