@@ -2,10 +2,13 @@
 # Style checks as one command. Prefers ruff (config in pyproject.toml);
 # this build image does not ship it, so absent ruff the script degrades to
 # the checks the stdlib can do — a full-tree compile (syntax) plus pyflakes
-# or flake8 when either exists — rather than skipping silently.
+# or flake8 when either exists — rather than skipping silently. Either way
+# the run finishes with dpowlint (python -m tpu_dpow.analysis): the
+# project's own AST invariant checkers for the Clock/async/metrics/topic
+# contracts (docs/analysis.md).
 #
 #   scripts/lint.sh [paths...]     # default: the package + tests + benchmarks
-set -euo pipefail
+set -uo pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
@@ -15,20 +18,30 @@ if [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tpu_dpow tests benchmarks scripts)
 fi
 
+style_rc=0
 if command -v ruff >/dev/null 2>&1; then
-    exec ruff check "${TARGETS[@]}"
+    ruff check "${TARGETS[@]}" || style_rc=$?
 elif python -c 'import ruff' >/dev/null 2>&1; then
-    exec python -m ruff check "${TARGETS[@]}"
+    python -m ruff check "${TARGETS[@]}" || style_rc=$?
+else
+    echo "lint.sh: ruff not installed — falling back to compileall" >&2
+    python -m compileall -q "${TARGETS[@]}" || style_rc=$?
+    ran_alt=0
+    for alt in pyflakes flake8; do
+        if python -c "import $alt" >/dev/null 2>&1; then
+            echo "lint.sh: running $alt" >&2
+            python -m "$alt" "${TARGETS[@]}" || style_rc=$?
+            ran_alt=1
+            break
+        fi
+    done
+    if [ "$style_rc" -eq 0 ] && [ "$ran_alt" -eq 0 ]; then
+        echo "lint.sh: syntax check passed (install ruff for the full rule set)" >&2
+    fi
 fi
 
-echo "lint.sh: ruff not installed — falling back to compileall" >&2
-python -m compileall -q "${TARGETS[@]}"
+# Project invariant checkers (always run, stdlib-only — docs/analysis.md).
+dpowlint_rc=0
+python -m tpu_dpow.analysis || dpowlint_rc=$?
 
-for alt in pyflakes flake8; do
-    if python -c "import $alt" >/dev/null 2>&1; then
-        echo "lint.sh: running $alt" >&2
-        exec python -m "$alt" "${TARGETS[@]}"
-    fi
-done
-
-echo "lint.sh: syntax check passed (install ruff for the full rule set)" >&2
+exit $(( style_rc || dpowlint_rc ))

@@ -26,4 +26,20 @@ FLEET_TESTS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py --collect-only -q -p no:cacheprovider \
     2>/dev/null | grep -c '::' || true)
 echo "FLEET_TESTS=${FLEET_TESTS}"
+# dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
+# or how many findings escaped the baseline (docs/analysis.md).
+DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)
+dlrc=$?
+if [ "$dlrc" -eq 0 ]; then
+    echo "DPOWLINT=clean"
+else
+    DLCOUNT=$(printf '%s\n' "$DPOWLINT_OUT" | grep -c '  DPOW')
+    if [ "$DLCOUNT" -gt 0 ]; then
+        echo "DPOWLINT=${DLCOUNT} findings"
+    else
+        # nonzero exit with zero findings = the linter itself broke
+        # (crash/timeout); never report that as near-clean
+        echo "DPOWLINT=error (rc=$dlrc)"
+    fi
+fi
 exit "$rc"

@@ -10,6 +10,8 @@ from tpu_dpow.backend.jax_backend import JaxWorkBackend
 from tpu_dpow.models import WorkRequest, WorkType
 from tpu_dpow.utils import nanocrypto as nc
 
+from conftest import requires_shard_map
+
 RNG = np.random.default_rng(5)
 EASY = 0xFFF0000000000000  # ~1 in 4096 nonces: a few ms on the CPU path
 
@@ -203,6 +205,7 @@ def test_one_waiter_timeout_does_not_kill_dedup_waiters(backend):
 # (SURVEY.md §7 stage 7).
 
 
+@requires_shard_map
 def test_mesh_backend_generates_valid_work():
     async def run():
         b = make_backend(mesh_devices=8)
@@ -216,6 +219,7 @@ def test_mesh_backend_generates_valid_work():
     asyncio.run(run())
 
 
+@requires_shard_map
 def test_mesh_backend_concurrent_and_cancel():
     async def run():
         b = make_backend(mesh_devices=8)
@@ -236,6 +240,7 @@ def test_mesh_backend_concurrent_and_cancel():
     asyncio.run(run())
 
 
+@requires_shard_map
 def test_mesh_devices_one_builds_real_gang():
     """mesh_devices=1 must run the ACTUAL shard_map gang on a one-device
     mesh — the engine-level A/B that prices the gang machinery against the
@@ -315,6 +320,7 @@ def test_run_mode_cancel_between_runs():
     asyncio.run(run())
 
 
+@requires_shard_map
 def test_run_mode_mesh_generates_valid_work():
     async def run():
         b = make_backend(mesh_devices=8, run_steps=4)

@@ -39,6 +39,7 @@ from tpu_dpow.chaos import (
     FaultyTransport,
     Rule,
     invalid_work_for,
+    join_client,
 )
 from tpu_dpow.client import ClientConfig, DpowClient
 from tpu_dpow.models import WorkRequest
@@ -329,7 +330,10 @@ def test_chaos_dropped_publish_and_killed_responder_heal_via_redispatch():
             backend=BruteBackend(),
         )
         for c in (client_a, client_b):
-            await c.setup()
+            # the server heartbeat beats on the FakeClock now — re-beat it
+            # through each startup gate (a later joiner would otherwise
+            # wait for a beat that only advance() can fire)
+            await join_client(c, server)
             c.start_loops()
 
         # passive observer: which cancel topics does the winner fan out to?

@@ -17,7 +17,7 @@ import pytest
 
 from tpu_dpow import obs
 from tpu_dpow.backend import WorkBackend
-from tpu_dpow.chaos import FakeClock
+from tpu_dpow.chaos import FakeClock, join_client
 from tpu_dpow.client import ClientConfig, DpowClient
 from tpu_dpow.fleet import (
     BROADCAST,
@@ -616,7 +616,9 @@ async def _start_fleet_stack(clock, broker, store, rates, **server_overrides):
             InProcTransport(broker, client_id=f"worker{i}", clean_session=False),
             backend=backend,
         )
-        await c.setup()
+        # re-beat the heartbeat through each startup gate: the server's
+        # clock-driven beat loop only fires when scenario time advances
+        await join_client(c, server)
         c.start_loops()
         clients.append(c)
     return server, clients
@@ -638,7 +640,7 @@ def test_fleet_acceptance_shard_kill_recover_legacy_metrics():
             InProcTransport(broker, client_id="legacy", clean_session=False),
             backend=legacy_backend,
         )
-        await legacy.setup()
+        await join_client(legacy, server)
         legacy.start_loops()
         try:
             await settle()

@@ -21,6 +21,8 @@ import sys
 
 import pytest
 
+from conftest import requires_num_cpu_devices
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multihost_worker.py")
 
@@ -31,6 +33,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@requires_num_cpu_devices
 def test_two_process_multihost_search():
     # bounded by the 150 s communicate() timeout on each worker below
     port = _free_port()

@@ -26,6 +26,8 @@ from tpu_dpow.parallel import (
 )
 from tpu_dpow.utils import nanocrypto as nc
 
+from conftest import requires_shard_map
+
 CHUNK = 256  # tiny per-shard windows: tests stay fast on CPU
 
 
@@ -53,6 +55,7 @@ def test_mesh_shape():
     assert m2.shape[NONCE_AXIS] == len(jax.devices()) // 4
 
 
+@requires_shard_map
 def test_finds_planted_nonce_in_any_shard(mesh):
     """A solution planted in each chip's sub-range is found with the correct
     global offset — the disjoint-range split leaves no gaps or overlaps."""
@@ -72,6 +75,7 @@ def test_finds_planted_nonce_in_any_shard(mesh):
         assert _plant_solution(h, won) >= diff
 
 
+@requires_shard_map
 def test_winner_election_picks_global_minimum(mesh):
     """Two planted solutions in different shards: pmin elects the lower
     offset — deterministic, unlike the reference's first-message race."""
@@ -89,12 +93,14 @@ def test_winner_election_picks_global_minimum(mesh):
     assert _plant_solution(h, search.nonce_from_offset(base, got)) >= diff
 
 
+@requires_shard_map
 def test_dry_window_returns_sentinel(mesh):
     params = replicate_params(_params(bytes(32), (1 << 64) - 1, 123), mesh)
     out = sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=CHUNK)
     assert int(np.asarray(out)[0]) == int(search.SENTINEL)
 
 
+@requires_shard_map
 def test_matches_single_chip_scan(mesh):
     """The ganged window must equal one big single-chip window bit-for-bit."""
     h = secrets.token_bytes(32)
@@ -109,6 +115,7 @@ def test_matches_single_chip_scan(mesh):
     assert int(np.asarray(ganged)[0]) == int(np.asarray(single)[0])
 
 
+@requires_shard_map
 def test_batched_requests_independent(mesh):
     """Batch lanes are independent: planted hit in lane 0, dry lane 1."""
     h0, h1 = secrets.token_bytes(32), secrets.token_bytes(32)
@@ -128,6 +135,7 @@ def test_batched_requests_independent(mesh):
     assert int(out[1]) == int(search.SENTINEL)
 
 
+@requires_shard_map
 def test_batch_sharded_mesh(mesh):
     """2D mesh (batch=4, nonce=2): requests spread across chip groups."""
     m = make_mesh(jax.devices(), batch_shards=4)
@@ -143,6 +151,7 @@ def test_batch_sharded_mesh(mesh):
     assert all(int(o) <= 3 for o in out)
 
 
+@requires_shard_map
 def test_sharded_search_run_to_solution(mesh):
     """The device-resident while_loop runs windows until a real solution at a
     moderate difficulty, and the winning nonce validates via hashlib."""
@@ -162,6 +171,7 @@ def test_sharded_search_run_to_solution(mesh):
     assert nc.work_value(h.hex(), work) >= diff
 
 
+@requires_shard_map
 def test_sharded_pallas_multiblock_matches_xla(mesh):
     """Persistent-kernel mode per shard (nblocks>1, group>1) must return the
     same winner as the plain XLA scanner over the identical ganged window —
@@ -201,6 +211,7 @@ def test_sharded_pallas_geometry_mismatch_rejected(mesh):
         )
 
 
+@requires_shard_map
 def test_sharded_run_pallas_multiblock_to_solution(mesh):
     """sharded_search_run with the persistent-kernel geometry converges and
     the winning nonce validates — the flagship 8-chip latency configuration
@@ -230,6 +241,7 @@ def test_global_chunk_cap_enforced(mesh):
         )
 
 
+@requires_shard_map
 def test_sharded_run_active_mask_skips_padding(mesh):
     """Padding rows (unreachable difficulty, active=False) must not hold the
     device-resident while_loop at max_steps once real rows have solved."""
@@ -288,6 +300,7 @@ def test_arrange_by_host_rejects_ragged_slice():
         arrange_by_host([_StubDev(0, 0), _StubDev(1, 0), _StubDev(2, 1)])
 
 
+@requires_shard_map
 def test_multihost_mesh_single_process_runs_search():
     """With one process the multihost mesh is (1, n_local) — and the ganged
     search must run on it exactly as on make_mesh's latency mode."""

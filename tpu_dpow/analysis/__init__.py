@@ -1,0 +1,36 @@
+"""dpowlint: AST-based invariant checkers for this repo's own contracts.
+
+Four subsystems (obs, resilience, sched, fleet) rest on project-wide
+conventions that nothing enforced mechanically until now:
+
+  * every timer must run on the injectable ``resilience.Clock`` — a stray
+    ``time.time()`` silently exempts its code path from every FakeClock
+    chaos test (DPOW101);
+  * async paths must never block the event loop — the PR-4 soak flake was
+    exactly a hidden blocking compile on the dispatch path (DPOW201);
+  * ``asyncio.create_task`` results must be retained or the task is
+    GC-cancellable mid-flight (DPOW301), and no ``await`` may sit inside a
+    held ``threading.Lock`` (DPOW401);
+  * the ``dpow_*`` metric catalogue, the MQTT topic grammar + ACL matrix,
+    and the ``--flag`` tables in docs/ must match the code (DPOW5xx/6xx/7xx)
+    — PR 4 had to hand-extend ACLs, which is the bug class these close.
+
+Stdlib only (ast + tokenize): the build image has no ruff, and the checks
+are project-specific anyway. Run as ``python -m tpu_dpow.analysis``; wired
+into scripts/lint.sh and tier-1 via tests/test_analysis.py. Catalogue and
+waiver syntax: docs/analysis.md.
+"""
+
+from .core import Baseline, Finding, Project, run_all  # noqa: F401
+from . import blocking, clock, flags, locks, metrics, tasks, topics  # noqa: F401
+
+#: checker registry, in catalogue order (docs/analysis.md)
+CHECKERS = (
+    clock.check,
+    blocking.check,
+    tasks.check,
+    locks.check,
+    metrics.check,
+    topics.check,
+    flags.check,
+)

@@ -1,0 +1,109 @@
+"""CLI: ``python -m tpu_dpow.analysis [--root DIR] [--write-baseline]``.
+
+Exit 0 when every finding is inline-waived or baselined, 1 otherwise.
+Output format (one per line): ``path:line  CODE  message``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import CHECKERS
+from .core import DEFAULT_BASELINE, Baseline, Project, run_all
+
+_CATALOGUE = """\
+DPOW101  clock-discipline    timers must ride the injectable resilience.Clock
+DPOW201  async-blocking      no blocking calls lexically inside async def
+DPOW301  task-leak           create_task/ensure_future results must be retained
+DPOW401  lock-across-await   no await while holding a threading lock
+DPOW501  metrics-contract    dpow_* metric registered but not catalogued in docs
+DPOW502  metrics-contract    catalogued metric registered nowhere in code
+DPOW503  metrics-contract    label sets disagree between code and catalogue
+DPOW504  metrics-contract    metric kind disagrees between code and catalogue
+DPOW601  topic-contract      topic used in code but absent from the spec table
+DPOW602  topic-contract      spec topic exercised nowhere in code
+DPOW603  topic-contract      publish/subscribe not permitted by users.json ACLs
+DPOW604  topic-contract      ACL drift between spec / users.json / code defaults
+DPOW701  flag-drift          config flag missing from docs/flags.md
+DPOW702  flag-drift          documented flag no config declares
+DPOW703  flag-drift          documented default != declared default
+
+Waive inline with `# dpowlint: disable=CODE — justification` (applies to
+that line and the next); park intentional debt in the baseline file.
+Details: docs/analysis.md."""
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "python -m tpu_dpow.analysis",
+        description="dpowlint: AST-based invariant checkers for the "
+        "async/Clock/metrics/topic/flag contracts (docs/analysis.md)",
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="project root (default: two levels above this package)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: tpu_dpow/analysis/baseline.txt)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept every current finding into the baseline and exit 0",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report baselined findings too (the full debt view)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="print the checker catalogue"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        print(_CATALOGUE)
+        return 0
+
+    root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
+    baseline_path = (
+        Path(args.baseline) if args.baseline else Path(__file__).parent / DEFAULT_BASELINE
+    )
+    project = Project(root)
+    findings = run_all(project, CHECKERS)
+
+    if args.write_baseline:
+        Baseline().save(baseline_path, findings)
+        print(
+            f"dpowlint: wrote {len(findings)} finding(s) to {baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
+    fresh = [f for f in findings if not baseline.covers(f)]
+    for f in fresh:
+        print(f.render())
+    baselined = len(findings) - len(fresh)
+    if fresh:
+        print(
+            f"dpowlint: {len(fresh)} finding(s)"
+            + (f" ({baselined} baselined)" if baselined else ""),
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        "dpowlint: clean"
+        + (f" ({baselined} baselined finding(s) remain)" if baselined else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

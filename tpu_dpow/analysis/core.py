@@ -1,0 +1,226 @@
+"""dpowlint framework: sources, findings, waivers, baseline.
+
+A checker is ``def check(project: Project) -> list[Finding]``. The Project
+owns the parsed package sources and the doc/config paths the contract
+checkers cross-reference, so tests can point a checker at a fixture tree
+(or the real package with doctored docs) without monkeypatching.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: comment syntax: ``# dpowlint: disable=DPOW101[,DPOW201] — justification``
+#: A waiver applies to its own line and to the line directly below it (so a
+#: standalone comment can sit above a long statement).
+WAIVER_RE = re.compile(r"#\s*dpowlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # project-root-relative, forward slashes
+    line: int
+    code: str  # DPOWnnn
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}  {self.code}  {self.message}"
+
+    def key(self) -> str:
+        """Line-number-free fingerprint: baselined findings must survive
+        unrelated edits shifting the file."""
+        return f"{self.path}  {self.code}  {self.message}"
+
+
+class SourceFile:
+    """One parsed .py file: AST + the waiver comments tokenize found."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text(encoding="utf-8")
+        self.tree = ast.parse(self.text, filename=rel)
+        self.waivers: Dict[int, Set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = WAIVER_RE.search(tok.string)
+                if m:
+                    codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                    self.waivers.setdefault(tok.start[0], set()).update(codes)
+        except tokenize.TokenError:
+            pass
+
+    def waived(self, code: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            if code in self.waivers.get(ln, ()) or "ALL" in self.waivers.get(ln, ()):
+                return True
+        return False
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, str]:
+    """Top-level ``NAME = "literal"`` assignments (metric/topic constants)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map local names to dotted origins: ``import time as t`` → t: time;
+    ``from asyncio import sleep`` → sleep: asyncio.sleep."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render Name/Attribute chains as ``a.b.c`` (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_call(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """The call target's dotted origin after import aliasing: a call to
+    ``t.sleep`` with ``import time as t`` resolves to ``time.sleep``."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    origin = aliases.get(head)
+    if origin:
+        return f"{origin}.{rest}" if rest else origin
+    return name
+
+
+class Project:
+    """The tree under analysis. ``package_dir``/``docs_dir`` are overridable
+    so fixture tests can run one checker against a synthetic layout."""
+
+    def __init__(
+        self,
+        root,
+        package_dir: str = "tpu_dpow",
+        docs_dir: str = "docs",
+        setup_users: str = "setup/broker/users.json",
+        exclude: Tuple[str, ...] = ("analysis/",),
+    ):
+        self.root = Path(root)
+        self.package_dir = package_dir
+        self.docs_dir = docs_dir
+        self.setup_users = setup_users
+        self.exclude = exclude
+        self._sources: Optional[List[SourceFile]] = None
+
+    # -- sources -------------------------------------------------------
+
+    def sources(self, include_excluded: bool = False) -> List[SourceFile]:
+        if self._sources is None:
+            files = sorted((self.root / self.package_dir).rglob("*.py"))
+            out = []
+            for f in files:
+                if "__pycache__" in f.parts:
+                    continue
+                rel = f.relative_to(self.root).as_posix()
+                out.append(SourceFile(f, rel))
+            self._sources = out
+        if include_excluded:
+            return list(self._sources)
+        pkg = self.package_dir.rstrip("/") + "/"
+        return [
+            s
+            for s in self._sources
+            if not any(s.rel.startswith(pkg + e) for e in self.exclude)
+        ]
+
+    def doc(self, name: str) -> Optional[str]:
+        p = self.root / self.docs_dir / name
+        return p.read_text(encoding="utf-8") if p.exists() else None
+
+    def constants(self, src: SourceFile) -> Dict[str, str]:
+        return _module_constants(src.tree)
+
+
+# -- baseline ----------------------------------------------------------
+
+
+@dataclass
+class Baseline:
+    """Committed debt file: one ``Finding.key()`` per line. Entries must
+    carry a trailing ``  # why`` justification to be legible in review;
+    ``#`` lines and blanks are ignored."""
+
+    entries: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        entries: Set[str] = set()
+        p = Path(path)
+        if p.exists():
+            for raw in p.read_text(encoding="utf-8").splitlines():
+                line = raw.split(" # ")[0].strip() if " # " in raw else raw.strip()
+                if line and not line.startswith("#"):
+                    entries.add(line)
+        return cls(entries)
+
+    def save(self, path, findings: Iterable[Finding]) -> None:
+        lines = [
+            "# dpowlint baseline: accepted findings (python -m tpu_dpow.analysis",
+            "# --write-baseline). Every entry is intentional debt and should",
+            '# carry a trailing " # why". Keep this file empty when you can.',
+        ]
+        lines += sorted(f.key() for f in findings)
+        Path(path).write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+    def covers(self, finding: Finding) -> bool:
+        return finding.key() in self.entries
+
+
+DEFAULT_BASELINE = "baseline.txt"  # sibling of this module
+
+
+def run_all(project: Project, checkers=None) -> List[Finding]:
+    """Every checker over the project; inline-waived findings removed,
+    baseline NOT applied (that is the CLI's job)."""
+    if checkers is None:
+        from . import CHECKERS
+
+        checkers = CHECKERS
+    by_rel = {s.rel: s for s in project.sources(include_excluded=True)}
+    out: List[Finding] = []
+    for check in checkers:
+        for f in check(project):
+            src = by_rel.get(f.path)
+            if src is not None and src.waived(f.code, f.line):
+                continue
+            out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.code, f.message))

@@ -18,6 +18,8 @@ time inside tier-1. Chaos tests carry the ``chaos`` pytest marker; the
 end-to-end scripted scenario lives in scripts/chaos_demo.py.
 """
 
+import asyncio as _asyncio
+
 from ..resilience.clock import FakeClock, SystemClock  # noqa: F401
 from .backend import FaultyBackend, invalid_work_for  # noqa: F401
 from .schedule import (  # noqa: F401
@@ -35,3 +37,36 @@ from .schedule import (  # noqa: F401
 )
 from .store import FaultyStore  # noqa: F401
 from .transport import FaultyTransport  # noqa: F401
+
+
+async def join_client(client, server):
+    """``await client.setup()`` against a FakeClock server, without
+    moving time.
+
+    The server's heartbeat loop beats on the injectable clock (dpowlint
+    DPOW101), so under a FakeClock a beat only fires when the scenario
+    advances time — and a client joining BETWEEN beats would wait out its
+    real-time startup gate against a frozen clock. Advancing the clock to
+    feed the gate would drift every subsequent choreographed deadline, so
+    instead this re-publishes the heartbeat directly (exactly what the
+    loop would do) until setup resolves. Scenario time stays untouched.
+    """
+    task = _asyncio.ensure_future(client.setup())
+    try:
+        for _ in range(500):  # bounded: fail fast instead of spinning forever
+            if task.done():
+                return task.result()
+            await server.transport.publish("heartbeat", "", qos=0)
+            for _ in range(20):  # let the frame flow broker → client → gate
+                await _asyncio.sleep(0)
+        raise TimeoutError(
+            "client.setup() did not resolve within 500 heartbeat rounds — "
+            "it is stuck on something other than the startup gate"
+        )
+    finally:
+        # any non-success exit (timeout above, a chaos-injected publish
+        # error, outer cancellation) must not strand the half-initialized
+        # setup task
+        if not task.done():
+            task.cancel()
+            await _asyncio.gather(task, return_exceptions=True)

@@ -19,13 +19,13 @@ from __future__ import annotations
 
 import asyncio
 import json
-import time
 import traceback
 from typing import Optional
 
 from .. import obs
 from ..backend import WorkBackend, get_backend
 from ..models import WorkRequest, WorkType
+from ..resilience.clock import Clock, SystemClock
 from ..transport import Message, QOS_0, QOS_1, Transport
 from ..transport.mqtt_codec import encode_result_payload, parse_work_payload
 from ..utils import nanocrypto as nc
@@ -42,9 +42,14 @@ class DpowClient:
         config: ClientConfig,
         transport: Transport,
         backend: Optional[WorkBackend] = None,
+        clock: Optional[Clock] = None,
     ):
         self.config = config
         self.transport = transport
+        # Injectable time (resilience/clock.py): every worker timer — the
+        # announce heartbeat, the staleness watchdog, reconnect backoff —
+        # must be FakeClock-drivable or chaos tests silently skip it.
+        self.clock = clock or SystemClock()
         if backend is None:
             backend = self._build_backend(config)
         # The handler's in-flight cap must exceed the engine's batch size or
@@ -234,7 +239,7 @@ class DpowClient:
         and its in-flight shards are re-covered onto the rest of the
         fleet."""
         while True:
-            await asyncio.sleep(self.config.fleet_announce_interval)
+            await self.clock.sleep(self.config.fleet_announce_interval)
             try:
                 await self._announce()
             except Exception as e:
@@ -243,7 +248,7 @@ class DpowClient:
     async def _await_first_heartbeat(self) -> None:
         async for msg in self.transport.messages():
             if msg.topic == "heartbeat":
-                self.last_heartbeat = time.monotonic()
+                self.last_heartbeat = self.clock.time()
                 return
 
     # -- message dispatch (reference :97-105) ---------------------------
@@ -251,7 +256,7 @@ class DpowClient:
     async def handle_message(self, msg: Message) -> None:
         topic = msg.topic
         if topic == "heartbeat":
-            self.last_heartbeat = time.monotonic()
+            self.last_heartbeat = self.clock.time()
         elif topic.startswith("work/"):
             # work/{type} (broadcast) or work/{type}/{worker_id} (this
             # worker's sharded-dispatch lane) — the type is segment 1
@@ -335,8 +340,8 @@ class DpowClient:
     async def _heartbeat_check_loop(self) -> None:
         """Staleness watchdog (reference :167-179)."""
         while True:
-            await asyncio.sleep(1.0)
-            self._heartbeat_tick(time.monotonic())
+            await self.clock.sleep(1.0)
+            self._heartbeat_tick(self.clock.time())
 
     def start_loops(self) -> None:
         self._tasks = [
@@ -354,7 +359,7 @@ class DpowClient:
         The reference's worker only ever logs per-work lines; rates need
         external scraping there."""
         while True:
-            await asyncio.sleep(interval)
+            await self.clock.sleep(interval)
             backend = self.work_handler.backend
             logger.info(
                 "engine stats: %s | device hashes=%s solutions=%s",
@@ -380,7 +385,7 @@ class DpowClient:
                 logger.error("reconnect setup failed; retrying in %.0fs:\n%s",
                              self.config.reconnect_delay, traceback.format_exc())
                 await self.close(reconnecting=True)
-                await asyncio.sleep(self.config.reconnect_delay)
+                await self.clock.sleep(self.config.reconnect_delay)
                 continue
             first = False
             try:
@@ -408,7 +413,7 @@ class DpowClient:
                 logger.error("client crashed; reconnecting in %.0fs:\n%s",
                              self.config.reconnect_delay, traceback.format_exc())
                 await self.close(reconnecting=True)
-                await asyncio.sleep(self.config.reconnect_delay)
+                await self.clock.sleep(self.config.reconnect_delay)
 
     async def close(self, reconnecting: bool = False) -> None:
         if self.config.fleet and not reconnecting and self.transport.connected:

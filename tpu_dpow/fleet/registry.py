@@ -125,6 +125,7 @@ class WorkerRegistry:
         churn mints fresh ids and the store would otherwise accumulate
         corpses that every restart resurrects for a ttl of dead lanes."""
         now = self.clock.time()
+        # dpowlint: disable=DPOW101 — cross-restart store hygiene needs wall clock; monotonic stamps die with the process
         wall = time.time()
         count = 0
         for key in await self.store.keys(f"{STORE_PREFIX}*"):
@@ -172,6 +173,7 @@ class WorkerRegistry:
                 "announces": str(info.announces),
                 # Coarse wall-clock stamp, for cross-restart store hygiene
                 # only (monotonic clocks do not survive the process).
+                # dpowlint: disable=DPOW101 — deliberate wall clock, see above
                 "seen_wall": repr(time.time()),
             },
         )

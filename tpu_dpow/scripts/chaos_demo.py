@@ -38,6 +38,7 @@ from ..chaos import (
     FaultyStore,
     FaultyTransport,
     Rule,
+    join_client,
 )
 from ..client import ClientConfig, DpowClient
 from ..resilience import FailoverBackend
@@ -147,7 +148,9 @@ async def scenario() -> dict:
         InProcTransport(broker, client_id="demo-worker"),
         backend=chain,
     )
-    await client.setup()
+    # re-beat the heartbeat through the startup gate — the server's
+    # clock-driven beat loop only fires when scenario time advances
+    await join_client(client, server)
     client.start_loops()
 
     log: list = []
@@ -313,7 +316,7 @@ async def fleet_scenario() -> dict:
                             clean_session=False),
             backend=_ParkedBackend(),
         )
-        await c.setup()
+        await join_client(c, server)
         c.start_loops()
         clients.append(c)
     try:

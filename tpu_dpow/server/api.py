@@ -132,11 +132,13 @@ def build_apps(server: DpowServer, broker=None):
         return web.json_response({"stats": broker.stats, "sessions": sessions})
 
     async def upcheck_blocks_handler(request: web.Request) -> web.Response:
-        if not server.last_block:
+        # `is None`, not falsy: a block stamped at FakeClock t=0.0 is a
+        # seen block, not the never-seen sentinel.
+        if server.last_block is None:
             return web.Response(text="")
-        import time
-
-        return web.Response(text=f"{time.time() - server.last_block:.2f}")
+        # Same clock that stamped last_block (block_arrival_handler) — the
+        # health face stays truthful under FakeClock tests too.
+        return web.Response(text=f"{server.clock.time() - server.last_block:.2f}")
 
     async def block_cb_handler(request: web.Request) -> web.Response:
         try:

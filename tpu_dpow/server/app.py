@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import time
 import traceback
 from typing import Dict, Optional
 
@@ -40,7 +39,7 @@ from ..fleet import (
 from ..models import DifficultyModel, WorkType
 from ..resilience import DispatchSupervisor, SystemClock
 from ..sched import AdmissionController
-from ..store import MemoryStore, Store
+from ..store import MemoryStore, Store, atomic_write
 from ..transport import Message, QOS_0, QOS_1, Transport
 from ..transport.mqtt_codec import parse_result_payload
 from ..utils import nanocrypto as nc
@@ -156,6 +155,10 @@ class DpowServer:
         self.last_block: Optional[float] = None
         self.work_republished = 0  # healed lost publishes (observability)
         self._tasks: list = []
+        # Fire-and-forget store writes in flight: the loop only holds weak
+        # refs to tasks, so an unretained ensure_future is GC-cancellable
+        # mid-write (dpowlint DPOW301) — retained here, reaped on done.
+        self._bg_tasks: set = set()
         self._started = False
         # Metrics (tpu_dpow.obs): the queue-depth / latency / outcome
         # signals the reference's two Redis counters cannot answer. Family
@@ -195,7 +198,7 @@ class DpowServer:
         await self.store.setup()
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             try:
-                self.store.load(self.config.checkpoint_path)
+                await asyncio.to_thread(self.store.load, self.config.checkpoint_path)
                 logger.info("restored state checkpoint from %s", self.config.checkpoint_path)
             except FileNotFoundError:
                 pass
@@ -231,14 +234,45 @@ class DpowServer:
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
 
+    def _spawn(self, coro) -> "asyncio.Task":
+        """Launch a fire-and-forget store write WITHOUT losing the task:
+        the loop's task set is weak, so a dropped ensure_future result can
+        be garbage-collected — and cancelled — mid-write."""
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
+
     async def close(self) -> None:
         self._started = False
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        if self._bg_tasks:
+            # Let in-flight counter/frontier writes land before the store
+            # goes away — but bounded: against a hung store (degraded
+            # backend mid-outage, chaos HANG) shutdown must not block
+            # forever on a fire-and-forget counter.
+            done, pending = await asyncio.wait(set(self._bg_tasks), timeout=2.0)
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            for t in done:
+                t.exception()  # consume, writes are best-effort
+            self._bg_tasks.clear()
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
-            self.store.save(self.config.checkpoint_path)
+            # Same split as the checkpoint loop: snapshot on the loop,
+            # write in a thread — and never let a failed final checkpoint
+            # skip the transport/store teardown below.
+            try:
+                blob = self.store.snapshot()
+                await asyncio.to_thread(
+                    atomic_write, self.config.checkpoint_path, blob
+                )
+            except Exception as e:
+                logger.warning("final checkpoint failed: %s", e)
         await self.transport.close()
         await self.store.close()
 
@@ -264,12 +298,12 @@ class DpowServer:
                 await self.transport.publish("heartbeat", "", qos=QOS_0)
             except Exception as e:
                 logger.warning("heartbeat publish failed: %s", e)
-            await asyncio.sleep(self.config.heartbeat_interval)
+            await self.clock.sleep(self.config.heartbeat_interval)
 
     async def _statistics_loop(self) -> None:
         """5-minute public statistics broadcast (reference dpow_server.py:82-93)."""
         while True:
-            await asyncio.sleep(self.config.statistics_interval)
+            await self.clock.sleep(self.config.statistics_interval)
             try:
                 stats = await self.all_statistics()
                 await self.transport.publish("statistics", json.dumps(stats), qos=QOS_0)
@@ -354,9 +388,15 @@ class DpowServer:
 
     async def _checkpoint_loop(self) -> None:
         while True:
-            await asyncio.sleep(self.config.checkpoint_interval)
+            await self.clock.sleep(self.config.checkpoint_interval)
             try:
-                self.store.save(self.config.checkpoint_path)
+                # Snapshot ON the loop (it iterates live dicts — a thread
+                # would race request coroutines mutating the store), then
+                # push only the blocking fsync'd write off the loop.
+                blob = self.store.snapshot()
+                await asyncio.to_thread(
+                    atomic_write, self.config.checkpoint_path, blob
+                )
             except Exception as e:
                 logger.warning("checkpoint failed: %s", e)
 
@@ -527,7 +567,7 @@ class DpowServer:
     async def block_arrival_handler(
         self, block_hash: str, account: str, previous: Optional[str]
     ) -> None:
-        self.last_block = time.time()
+        self.last_block = self.clock.time()
         should_precache = self.config.debug
         previous_exists = False
         old_frontier = await self.store.get(f"account:{account}")
@@ -679,7 +719,7 @@ class DpowServer:
         the duration, request-latency histogram observed on every exit path
         (labeled by the work type actually served, or "unresolved" when the
         request died before the precache/on-demand decision)."""
-        t0 = time.monotonic()
+        t0 = self.clock.time()
         self._m_inflight.inc()
         served = {"work_type": "unresolved"}
         try:
@@ -687,7 +727,7 @@ class DpowServer:
         finally:
             self._m_inflight.dec()
             self._m_request_seconds.observe(
-                time.monotonic() - t0, served["work_type"]
+                self.clock.time() - t0, served["work_type"]
             )
 
     async def _service_request(self, data: dict, served: dict) -> dict:
@@ -761,7 +801,7 @@ class DpowServer:
 
             served["work_type"] = work_type
             self._m_requests.inc(1, work_type)
-            asyncio.ensure_future(self.store.hincrby(f"service:{service}", work_type))
+            self._spawn(self.store.hincrby(f"service:{service}", work_type))
 
             # Final validation: never hand a service bad work
             # (reference dpow_server.py:363-368, demoted there to a log line;
@@ -835,7 +875,7 @@ class DpowServer:
             self.supervisor.track(block_hash, deadline)
             try:
                 if account:
-                    asyncio.ensure_future(
+                    self._spawn(
                         self.store.set(
                             f"account:{account}", block_hash, expire=self.config.account_expiry
                         )

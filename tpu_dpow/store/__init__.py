@@ -277,18 +277,28 @@ class MemoryStore(Store):
                 self._expiry[key] = now + entry["ttl"]
 
     def save(self, path: str) -> None:
-        # Atomic replace: a crash/ENOSPC mid-write must never truncate the
-        # only durable copy (the periodic checkpoint overwrites in place).
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            f.write(self.snapshot())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_write(path, self.snapshot())
 
     def load(self, path: str) -> None:
         with open(path) as f:
             self.restore(f.read())
+
+
+def atomic_write(path: str, blob: str) -> None:
+    """Durable atomic replace: a crash/ENOSPC mid-write must never truncate
+    the only durable copy (the periodic checkpoint overwrites in place).
+
+    Split out of :meth:`MemoryStore.save` so the server can take the
+    snapshot ON the event loop (atomic w.r.t. coroutines — snapshot()
+    iterates live dicts) and push only this blocking fsync'd write to a
+    thread.
+    """
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def get_store(uri: Optional[str] = None, **kwargs) -> Store:

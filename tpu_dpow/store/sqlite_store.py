@@ -61,6 +61,7 @@ class SqliteStore(Store):
     async def setup(self) -> None:
         if self._db is not None:
             return  # idempotent: server setup() may run after a caller's
+        # dpowlint: disable=DPOW201 — one-time local-file open at startup; the connection must be born on the loop thread it serves (check_same_thread)
         self._db = sqlite3.connect(self.path)
         self._db.executescript(_SCHEMA)
         # WAL: readers never block the writer; fits the single-writer

@@ -225,7 +225,7 @@ class MqttTransport(TcpTransport):
 
     async def _ping_loop(self) -> None:
         while not self._closed:
-            await asyncio.sleep(self.KEEPALIVE / 2)
+            await self._sleep(self.KEEPALIVE / 2)
             if self._closed:
                 return
             if self._connected:

@@ -171,6 +171,11 @@ class TcpBrokerServer:
 class TcpTransport(Transport):
     """Reconnecting TCP client endpoint."""
 
+    # Injectable sleep seam (same idiom as nano_ws): reconnect backoff and
+    # the MQTT subclass's keepalive ride through it so tests can collapse
+    # the waits without monkeypatching asyncio.
+    _sleep = staticmethod(asyncio.sleep)
+
     def __init__(
         self,
         host: str = "127.0.0.1",
@@ -256,7 +261,7 @@ class TcpTransport(Transport):
                 raise
             except Exception as e:
                 last_error = e
-                await asyncio.sleep(delay)
+                await self._sleep(delay)
                 delay = min(delay * 2, self.reconnect_max_interval)
         raise TransportError(f"could not reach broker at {self.host}:{self.port}: {last_error}")
 

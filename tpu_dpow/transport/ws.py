@@ -148,6 +148,7 @@ class WsBrokerServer:
                     # Backpressure: a transportless StreamReader buffers
                     # without bound; don't outrun the MQTT handler.
                     while len(getattr(reader, "_buffer", b"")) > 1 << 20:
+                        # dpowlint: disable=DPOW101 — real-socket buffer poll, not a timer; FakeClock cannot drive live websocket I/O
                         await asyncio.sleep(0.02)
                         if reader.at_eof():
                             return

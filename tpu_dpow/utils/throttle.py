@@ -23,11 +23,18 @@ class Throttler:
     sub-1 --throttle values the server's min of 0.1 explicitly allows).
     """
 
-    def __init__(self, rate_limit: float, period: float = 1.0, clock=time.monotonic):
+    def __init__(
+        self,
+        rate_limit: float,
+        period: float = 1.0,
+        clock=time.monotonic,
+        sleep=asyncio.sleep,
+    ):
         if rate_limit <= 0:
             raise ValueError("rate_limit must be positive")
         self.rate_limit = rate_limit
         self.period = period
+        self._sleep = sleep
         # Integral admit count; the window scales so ANY fractional rate is
         # honored exactly (0.5 → 1 per 2·period; 1.5 → 1 per period/1.5),
         # not floor-truncated.
@@ -48,8 +55,9 @@ class Throttler:
             if len(self._starts) < self._capacity:
                 self._starts.append(now)
                 return self
-            # Sleep until the oldest start slides out of the window.
-            await asyncio.sleep(max(self._starts[0] + self._window - now, 0.001))
+            # Sleep until the oldest start slides out of the window (the
+            # sleep seam pairs with the clock one: inject both or neither).
+            await self._sleep(max(self._starts[0] + self._window - now, 0.001))
 
     async def __aexit__(self, *exc):
         return False
