@@ -48,6 +48,21 @@ requires_shard_map = pytest.mark.skipif(
     "from jax.experimental in 0.6) — the shard_map gang paths cannot run",
 )
 
+#: The device-parallel suite's fixture: the 8 fake CPU devices forced at
+#: the top of this file (XLA_FLAGS before jax import — the same trick a
+#: subprocess harness would use, done in-process because conftest runs
+#: before any jax code). The shard_map-FREE fan path
+#: (tpu_dpow/parallel/fan_search.py) runs on them on EVERY supported jax,
+#: so the device-parallel tests execute in tier-1 instead of skipping;
+#: only the shard_map *variant* stays capability-gated below.
+N_FAN_DEVICES = len(jax.devices())
+requires_fan_devices = pytest.mark.skipif(
+    N_FAN_DEVICES < 8,
+    reason=f"need 8 local devices for the device-parallel suite, have "
+    f"{N_FAN_DEVICES} — xla_force_host_platform_device_count not applied?",
+)
+
+
 #: the per-process virtual-CPU-device config option the multihost harness
 #: children use (XLA_FLAGS cannot be changed after backend init in-process).
 HAS_NUM_CPU_DEVICES = hasattr(jax.config, "jax_num_cpu_devices")

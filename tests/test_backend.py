@@ -10,7 +10,7 @@ from tpu_dpow.backend.jax_backend import JaxWorkBackend
 from tpu_dpow.models import WorkRequest, WorkType
 from tpu_dpow.utils import nanocrypto as nc
 
-from conftest import requires_shard_map
+from conftest import requires_fan_devices, requires_shard_map
 
 RNG = np.random.default_rng(5)
 EASY = 0xFFF0000000000000  # ~1 in 4096 nonces: a few ms on the CPU path
@@ -18,6 +18,22 @@ EASY = 0xFFF0000000000000  # ~1 in 4096 nonces: a few ms on the CPU path
 
 def make_backend(**kw):
     return JaxWorkBackend(kernel="xla", sublanes=8, iters=8, **kw)
+
+
+#: The engine's two gang flavors share one contract; the device-parallel
+#: engine tests run once per flavor. 'fan' (pmap, parallel/fan_search.py)
+#: runs on every jax including this image's 0.4.37; the shard_map mesh
+#: variant stays capability-gated.
+GANG_BACKENDS = [
+    pytest.param("fan", id="fan", marks=requires_fan_devices),
+    pytest.param("mesh", id="shard_map", marks=requires_shard_map),
+]
+
+
+def make_gang_backend(impl, n=8, **kw):
+    if impl == "fan":
+        return make_backend(devices=n, **kw)
+    return make_backend(mesh_devices=n, **kw)
 
 
 def random_hash() -> str:
@@ -199,16 +215,17 @@ def test_one_waiter_timeout_does_not_kill_dedup_waiters(backend):
     asyncio.run(run())
 
 
-# -- mesh-ganged mode ---------------------------------------------------
-# mesh_devices > 1 puts all N (virtual CPU) devices on every hash through
-# the (batch, nonce) mesh — the flagship multi-chip latency configuration
-# (SURVEY.md §7 stage 7).
+# -- device-ganged mode -------------------------------------------------
+# devices >= 1 (pmap fan) or mesh_devices >= 1 (shard_map mesh) puts N
+# (virtual CPU) devices on every hash — the flagship multi-chip latency
+# configuration (SURVEY.md §7 stage 7). The fan is the shard_map-free
+# path this image's jax can run; the mesh variant is capability-gated.
 
 
-@requires_shard_map
-def test_mesh_backend_generates_valid_work():
+@pytest.mark.parametrize("impl", GANG_BACKENDS)
+def test_gang_backend_generates_valid_work(impl):
     async def run():
-        b = make_backend(mesh_devices=8)
+        b = make_gang_backend(impl)
         assert b.chunk == 8 * b.chunk_per_shard  # ganged window
         await b.setup()
         h = random_hash()
@@ -219,16 +236,18 @@ def test_mesh_backend_generates_valid_work():
     asyncio.run(run())
 
 
-@requires_shard_map
-def test_mesh_backend_concurrent_and_cancel():
+@pytest.mark.parametrize("impl", GANG_BACKENDS)
+def test_gang_backend_concurrent_and_cancel(impl):
     async def run():
-        b = make_backend(mesh_devices=8)
+        b = make_gang_backend(impl)
         await b.setup()
         reqs = [WorkRequest(random_hash(), EASY) for _ in range(3)]
         works = await asyncio.gather(*(b.generate(r) for r in reqs))
         for r, w in zip(reqs, works):
             nc.validate_work(r.block_hash, w, EASY)
-        # cancel an unreachable-difficulty job mid-flight
+        # cancel an unreachable-difficulty job mid-flight: the engine drops
+        # the job from the next pack, which stops EVERY device shard at its
+        # next window boundary.
         hard = random_hash()
         t = asyncio.ensure_future(b.generate(WorkRequest(hard, (1 << 64) - 2)))
         await asyncio.sleep(0.2)
@@ -240,36 +259,51 @@ def test_mesh_backend_concurrent_and_cancel():
     asyncio.run(run())
 
 
-@requires_shard_map
-def test_mesh_devices_one_builds_real_gang():
-    """mesh_devices=1 must run the ACTUAL shard_map gang on a one-device
-    mesh — the engine-level A/B that prices the gang machinery against the
-    plain path on real hardware. A `> 1` guard used to silently downgrade
-    it to the plain path, so the r4 latency_mesh1 capture measured
-    plain-vs-plain session drift and called it the gang tax."""
+@pytest.mark.parametrize("impl", GANG_BACKENDS)
+def test_gang_width_one_builds_real_gang(impl):
+    """devices=1 / mesh_devices=1 must run the ACTUAL gang machinery on a
+    one-device complement — the engine-level A/B that prices the gang
+    plumbing against the plain path on real hardware. A `> 1` guard used
+    to silently downgrade the mesh flavor to the plain path, so the r4
+    latency_mesh1 capture measured plain-vs-plain session drift and called
+    it the gang tax."""
 
     async def run():
-        b = make_backend(mesh_devices=1)
-        assert b.mesh is not None
+        b = make_gang_backend(impl, n=1)
+        if impl == "fan":
+            assert b.fan is not None and len(b.fan) == 1
+        else:
+            assert b.mesh is not None
         assert b.chunk == b.chunk_per_shard  # one shard, ungrown window
         await b.setup()
         h = random_hash()
         work = await b.generate(WorkRequest(h, EASY))
         nc.validate_work(h, work, EASY)
         await b.close()
-        # Default stays the plain path: an unganged engine has no mesh.
-        assert make_backend().mesh is None
+        # Default stays the plain path: an unganged engine has neither.
+        assert make_backend().mesh is None and make_backend().fan is None
 
     asyncio.run(run())
 
 
-def test_mesh_backend_rejects_oversubscription():
+def test_gang_backend_rejects_oversubscription():
     import jax
 
     from tpu_dpow.backend import WorkError
 
     with pytest.raises(WorkError):
         JaxWorkBackend(kernel="xla", mesh_devices=len(jax.devices()) + 1)
+    with pytest.raises(WorkError):
+        JaxWorkBackend(kernel="xla", devices=len(jax.devices()) + 1)
+
+
+def test_gang_flavors_mutually_exclusive():
+    from tpu_dpow.backend import WorkError
+
+    with pytest.raises(WorkError):
+        JaxWorkBackend(kernel="xla", devices=2, mesh_devices=2)
+    with pytest.raises(WorkError):
+        JaxWorkBackend(kernel="xla", devices=2, device_shard="bogus")
 
 
 # -- device-resident run mode (run_steps > 1) -----------------------------
@@ -320,10 +354,10 @@ def test_run_mode_cancel_between_runs():
     asyncio.run(run())
 
 
-@requires_shard_map
-def test_run_mode_mesh_generates_valid_work():
+@pytest.mark.parametrize("impl", GANG_BACKENDS)
+def test_run_mode_gang_generates_valid_work(impl):
     async def run():
-        b = make_backend(mesh_devices=8, run_steps=4)
+        b = make_gang_backend(impl, run_steps=4)
         await b.setup()
         h = random_hash()
         work = await b.generate(WorkRequest(h, EASY))
@@ -1269,3 +1303,354 @@ def test_pipelined_launch_timeout_fails_clean_and_recovers():
         await b.close()
 
     asyncio.run(run())
+
+
+# -- device fan: per-device shards, scan clocks, attribution ---------------
+# The fan engine sub-partitions one WorkRequest's nonce shard into disjoint
+# per-device ranges (the fleet partition idiom one level down) and keeps
+# per-device scan clocks on the injectable resilience Clock, so fleet
+# re-covers and EMA attribution work per DEVICE, not just per process.
+
+
+def test_fan_cover_range_rebases_all_device_shards():
+    """A fleet cover_range re-cover against the multi-device engine must
+    rebase EVERY device shard into the orphaned range — not just device 0.
+    (A single-frontier rebase would leave 7 of 8 sub-ranges scanning the
+    dead worker's old region.)"""
+    from tpu_dpow.ops import search as ops_search
+    from tpu_dpow.resilience.clock import FakeClock
+
+    async def run():
+        n = 4
+        b = make_backend(devices=n, device_shard="split", clock=FakeClock())
+        await b.setup()
+        seen = []  # per-launch [n] device base snapshots
+        real_launch = b._launch
+
+        def recording(params, steps):
+            if params.ndim == 3:
+                bases = [
+                    (int(params[d, 0, ops_search.BASE_HI]) << 32)
+                    | int(params[d, 0, ops_search.BASE_LO])
+                    for d in range(params.shape[0])
+                ]
+                seen.append(bases)
+            return real_launch(params, steps)
+
+        b._launch = recording
+        h = random_hash()
+        start_a, length = 1 << 30, 1 << 20
+        stride = length // n
+        t = asyncio.ensure_future(
+            b.generate(WorkRequest(h, (1 << 64) - 2, nonce_range=(start_a, length)))
+        )
+        while not seen:
+            await asyncio.sleep(0.01)
+        # Initial partition: device d scans from start_a + d*stride.
+        assert seen[0] == [start_a + d * stride for d in range(n)], seen[0]
+        start_b = 1 << 50
+        assert await b.cover_range(h, (start_b, length))
+        deadline = asyncio.get_running_loop().time() + 10.0
+        want = [start_b + d * stride for d in range(n)]
+        while not any(s == want for s in seen):
+            assert asyncio.get_running_loop().time() < deadline, (
+                "no launch rebased every device shard into the new range",
+                seen[-3:],
+            )
+            await asyncio.sleep(0.01)
+        await b.cancel(h)
+        with pytest.raises(WorkCancelled):
+            await t
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_fan_win_attributed_with_device_scan_clock():
+    """A win landing in device k's sub-range must EMA-attribute with THAT
+    device's scan clock (FakeClock-driven): hashes = nonces scanned from
+    k's shard start, elapsed = k's first-dispatch → apply on the injectable
+    clock — the engine-level twin of the fleet registry's observe_result."""
+    import hashlib
+    import threading
+
+    from tpu_dpow import obs
+    from tpu_dpow.resilience.clock import FakeClock
+
+    def value_of(h_bytes, nonce):
+        return int.from_bytes(
+            hashlib.blake2b(
+                nonce.to_bytes(8, "little") + h_bytes, digest_size=8
+            ).digest(),
+            "little",
+        )
+
+    async def run():
+        n = 4
+        clock = FakeClock()
+        b = make_backend(devices=n, device_shard="split", clock=clock)
+        await b.setup()
+        # Host-side: find the MAX-value nonce across every device's first
+        # window and target exactly it — the unique hit of the first fanned
+        # launch, so the winning device is deterministic.
+        h = random_hash()
+        hb = bytes.fromhex(h)
+        start, length = 1 << 40, n * (1 << 20)
+        stride = length // n
+        best = None
+        for d in range(n):
+            for j in range(b.chunk_per_shard):
+                v = value_of(hb, start + d * stride + j)
+                if best is None or v > best[0]:
+                    best = (v, d, j)
+        diff, k, off = best
+        gate = threading.Event()
+        real_launch = b._launch
+
+        def gated(params, steps):
+            if not gate.wait(timeout=10):
+                raise TimeoutError("fan launch gate never released")
+            return real_launch(params, steps)
+
+        b._launch = gated
+        wins_before = (
+            obs.snapshot()
+            .get("dpow_backend_device_wins_total", {})
+            .get("series", {})
+            .get(str(k), 0)
+        )
+        task = asyncio.ensure_future(
+            b.generate(WorkRequest(h, diff, nonce_range=(start, length)))
+        )
+        # Let the engine dispatch (stamping the per-device scan clocks at
+        # t=0), advance the fake clock 2 s, then release the launch.
+        while not b._jobs or next(iter(b._jobs.values())).dev_t0 is None:
+            await asyncio.sleep(0.01)
+        await clock.advance(2.0)
+        gate.set()
+        work = await asyncio.wait_for(task, timeout=20)
+        nc.validate_work(h, work, diff)
+        assert b.last_win is not None
+        assert b.last_win["device"] == k, b.last_win
+        assert b.last_win["hashes"] == off + 1, b.last_win
+        assert b.last_win["elapsed"] == pytest.approx(2.0), b.last_win
+        assert b.device_ema[k] == pytest.approx((off + 1) / 2.0)
+        assert all(b.device_ema[d] == 0.0 for d in range(n) if d != k)
+        wins_after = (
+            obs.snapshot()["dpow_backend_device_wins_total"]["series"][str(k)]
+        )
+        assert wins_after == wins_before + 1
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_fan_raise_difficulty_applies_to_every_device_shard():
+    """raise_difficulty against the fan engine must retarget EVERY device
+    shard: the next fanned launch carries the raised difficulty words in
+    all device slices, and coverage resets so the raised job re-dispatches
+    immediately (same contract as the single-device engine)."""
+    from tpu_dpow.ops import search as ops_search
+    from tpu_dpow.resilience.clock import FakeClock
+
+    async def run():
+        n = 4
+        b = make_backend(devices=n, device_shard="split", clock=FakeClock())
+        await b.setup()
+        diffs_seen = []  # per-launch [n] difficulty snapshots
+        real_launch = b._launch
+
+        def recording(params, steps):
+            if params.ndim == 3:
+                diffs_seen.append([
+                    (int(params[d, 0, ops_search.DIFF_HI]) << 32)
+                    | int(params[d, 0, ops_search.DIFF_LO])
+                    for d in range(params.shape[0])
+                ])
+            return real_launch(params, steps)
+
+        b._launch = recording
+        h = random_hash()
+        low = (1 << 64) - (1 << 30)
+        raised = (1 << 64) - (1 << 20)
+        t = asyncio.ensure_future(b.generate(WorkRequest(h, low)))
+        while not diffs_seen:
+            await asyncio.sleep(0.01)
+        assert diffs_seen[0] == [low] * n
+        assert await b.raise_difficulty(h, raised)
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while not any(ds == [raised] * n for ds in diffs_seen):
+            assert asyncio.get_running_loop().time() < deadline, diffs_seen[-3:]
+            await asyncio.sleep(0.01)
+        await b.cancel(h)
+        with pytest.raises(WorkCancelled):
+            await t
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_fan_per_device_metrics_exported():
+    """The fan exports the dpow_backend_device_* families with one series
+    per device (docs/observability.md catalogue): launches, scanned
+    nonces, last-launch H/s, busy fraction in [0, 1]."""
+    from tpu_dpow import obs
+
+    async def run():
+        n = 8
+        snap0 = obs.snapshot()
+
+        def series(snap, fam):
+            return snap.get(fam, {}).get("series", {})
+
+        launches0 = dict(series(snap0, "dpow_backend_device_launches_total"))
+        b = make_backend(devices=n)
+        await b.setup()
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(3)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        await b.close()
+        snap = obs.snapshot()
+        for d in range(n):
+            lab = str(d)
+            assert (
+                series(snap, "dpow_backend_device_launches_total").get(lab, 0)
+                > launches0.get(lab, 0)
+            ), f"device {d} recorded no launches"
+            assert series(snap, "dpow_backend_device_hashes_total").get(lab, 0) > 0
+            busy = series(snap, "dpow_backend_device_busy_fraction").get(lab)
+            assert busy is not None and 0.0 <= busy <= 1.0
+            assert series(snap, "dpow_backend_device_hash_rate_hs").get(lab, 0) >= 0
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_work_handler_fleet_recover_rebases_fan_engine():
+    """Fleet re-cover through the client dispatch boundary: a duplicate
+    work message carrying a DIFFERENT shard must rebase the RUNNING fan
+    job's every device sub-range (work_handler → backend.cover_range) and
+    count as 'recovered'."""
+    from tpu_dpow.client.work_handler import WorkHandler
+    from tpu_dpow.ops import search as ops_search
+
+    async def run():
+        n = 4
+        b = make_backend(devices=n, device_shard="split")
+        seen = []
+        real_launch = b._launch
+
+        def recording(params, steps):
+            if params.ndim == 3:
+                seen.append([
+                    (int(params[d, 0, ops_search.BASE_HI]) << 32)
+                    | int(params[d, 0, ops_search.BASE_LO])
+                    for d in range(params.shape[0])
+                ])
+            return real_launch(params, steps)
+
+        b._launch = recording
+
+        async def on_result(request, work):
+            pass
+
+        handler = WorkHandler(b, on_result, concurrency=2)
+        await handler.start()
+        h = random_hash()
+        start_a, start_b, length = 1 << 30, 1 << 50, 1 << 20
+        stride = length // n
+        await handler.queue_work(
+            WorkRequest(h, (1 << 64) - 2, nonce_range=(start_a, length))
+        )
+        while not seen:
+            await asyncio.sleep(0.01)
+        await handler.queue_work(
+            WorkRequest(h, (1 << 64) - 2, nonce_range=(start_b, length))
+        )
+        assert handler.stats["recovered"] == 1
+        want = [start_b + d * stride for d in range(n)]
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while not any(s == want for s in seen):
+            assert asyncio.get_running_loop().time() < deadline, seen[-3:]
+            await asyncio.sleep(0.01)
+        await handler.queue_cancel(h)
+        await handler.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_plain_weak_hit_cannot_rewind_a_cover_range_rebase():
+    """Single-device twin of the fan's epoch fence: a launch dispatched at
+    the OLD base whose hit goes weak (target raised mid-flight) must NOT
+    rewind the frontier after a cover_range re-cover — the rebase into the
+    orphaned range wins, and the engine keeps scanning there."""
+    import hashlib
+    import threading
+
+    from tpu_dpow.ops import search as ops_search
+
+    def value_of(h_bytes, nonce):
+        return int.from_bytes(
+            hashlib.blake2b(
+                nonce.to_bytes(8, "little") + h_bytes, digest_size=8
+            ).digest(),
+            "little",
+        )
+
+    async def run():
+        b = make_backend()  # plain path: no fan, no mesh
+        await b.setup()
+        h = random_hash()
+        hb = bytes.fromhex(h)
+        base_a, base_b = 1 << 30, 1 << 50
+        # The max-value nonce of the first window is the unique hit at
+        # difficulty == its value; raising to near-unreachable afterwards
+        # turns exactly that hit weak at apply time.
+        v_max, j = max(
+            (value_of(hb, base_a + j), j) for j in range(b.chunk)
+        )
+        gate = threading.Event()
+        bases = []
+        real_launch = b._launch
+
+        def gated(params, steps):
+            bases.append(
+                (int(params[0, ops_search.BASE_HI]) << 32)
+                | int(params[0, ops_search.BASE_LO])
+            )
+            if not gate.wait(timeout=10):
+                raise TimeoutError("launch gate never released")
+            return real_launch(params, steps)
+
+        b._launch = gated
+        t = asyncio.ensure_future(
+            b.generate(WorkRequest(h, v_max, nonce_range=(base_a, 1 << 20)))
+        )
+        while not bases:
+            await asyncio.sleep(0.01)
+        assert bases[0] == base_a
+        # Let the engine finish filling its pipeline against the gate so
+        # every pre-cover dispatch is recorded before the snapshot.
+        await asyncio.sleep(0.2)
+        n_pre = len(bases)
+        # Raise past every nonce, then re-cover to the far range — both
+        # while launch 1 (aimed at base_a, carrying the weak hit) is wired.
+        assert await b.raise_difficulty(h, (1 << 64) - 2)
+        assert await b.cover_range(h, (base_b, 1 << 20))
+        gate.set()
+        # The weak hit applies; the frontier must stay in the re-covered
+        # range: every later dispatch starts at/after base_b, never at the
+        # rewind target base_a + j + 1.
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while len(bases) < n_pre + 3:
+            assert asyncio.get_running_loop().time() < deadline, bases
+            await asyncio.sleep(0.01)
+        post = bases[n_pre:]
+        assert base_a + j + 1 not in post, (bases, j)
+        assert all(x >= base_b for x in post), post
+        await b.cancel(h)
+        with pytest.raises(WorkCancelled):
+            await t
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 30))

@@ -1,9 +1,16 @@
-"""Multi-chip shard_map search on the virtual 8-device CPU mesh.
+"""Multi-chip device-parallel search on the virtual 8-device CPU mesh.
 
 The reference has no analog of these tests: its 'multi-node' story is live
-clients racing over a real broker (SURVEY.md §4). Here the mesh path must be
+clients racing over a real broker (SURVEY.md §4). Here the gang path must be
 bit-identical to the single-chip scanner, with winner election moved into an
-ICI pmin instead of the Redis SETNX lock (reference server/dpow_server.py:138).
+on-device reduction instead of the Redis SETNX lock (reference
+server/dpow_server.py:138).
+
+TWO gang implementations share the contract and run the same assertions
+(parametrized below): the shard_map mesh (parallel/mesh_search.py, jax >=
+0.6 — capability-gated) and the pmap fan (parallel/fan_search.py — the
+shard_map-FREE path that runs on this image's jax 0.4.37, so the
+device-parallel suite executes in tier-1 instead of skipping).
 """
 
 import hashlib
@@ -19,6 +26,9 @@ from tpu_dpow.parallel import (
     BATCH_AXIS,
     NONCE_AXIS,
     expected_steps,
+    fan_search_chunk_batch,
+    fan_search_run,
+    has_shard_map,
     make_mesh,
     replicate_params,
     sharded_search_chunk_batch,
@@ -26,9 +36,62 @@ from tpu_dpow.parallel import (
 )
 from tpu_dpow.utils import nanocrypto as nc
 
-from conftest import requires_shard_map
+from conftest import requires_fan_devices, requires_shard_map
 
 CHUNK = 256  # tiny per-shard windows: tests stay fast on CPU
+
+#: Each gang test runs once per implementation. The fan runs everywhere
+#: (this image's tier-1 included); the shard_map mesh variant is gated on
+#: the jax >= 0.6 capability.
+GANG_IMPLS = [
+    pytest.param("fan", id="fan", marks=requires_fan_devices),
+    pytest.param("shard_map", id="shard_map", marks=requires_shard_map),
+]
+
+
+@pytest.fixture(params=GANG_IMPLS)
+def gang(request):
+    return request.param
+
+
+def _devs(n=None):
+    devices = jax.devices()
+    return devices if n is None else devices[:n]
+
+
+def gang_chunk_batch(impl, rows, *, chunk_per_shard, n_devices=None, **kw):
+    """One ganged window launch via either implementation → offsets[B]."""
+    devices = _devs(n_devices)
+    if impl == "fan":
+        return fan_search_chunk_batch(
+            rows, devices=devices, chunk_per_shard=chunk_per_shard, **kw
+        )
+    mesh = make_mesh(devices)
+    return np.asarray(
+        sharded_search_chunk_batch(
+            replicate_params(rows, mesh), mesh=mesh,
+            chunk_per_shard=chunk_per_shard, **kw
+        )
+    )
+
+
+def gang_run(impl, rows, active=None, *, chunk_per_shard, max_steps,
+             n_devices=None, **kw):
+    """Multi-step ganged search via either implementation → (lo, hi)[B]."""
+    devices = _devs(n_devices)
+    if impl == "fan":
+        lo, hi = fan_search_run(
+            rows, active, devices=devices, chunk_per_shard=chunk_per_shard,
+            max_steps=max_steps, **kw
+        )
+        return np.asarray(lo), np.asarray(hi)
+    mesh = make_mesh(devices)
+    lo, hi = sharded_search_run(
+        replicate_params(rows, mesh),
+        jnp.asarray(active) if active is not None else None,
+        mesh=mesh, chunk_per_shard=chunk_per_shard, max_steps=max_steps, **kw
+    )
+    return np.asarray(lo), np.asarray(hi)
 
 
 def _params(block_hash: bytes, difficulty: int, base: int) -> np.ndarray:
@@ -55,19 +118,32 @@ def test_mesh_shape():
     assert m2.shape[NONCE_AXIS] == len(jax.devices()) // 4
 
 
-@requires_shard_map
-def test_finds_planted_nonce_in_any_shard(mesh):
+def test_capability_probe_gates_engine_mesh_path():
+    """The engine's mesh_devices gate must agree with has_shard_map():
+    where the probe says no, constructing a mesh backend fails AT
+    CONSTRUCTION with the capability story (not an AttributeError from the
+    first launch); where it says yes, construction succeeds."""
+    from tpu_dpow.backend import WorkError
+    from tpu_dpow.backend.jax_backend import JaxWorkBackend
+
+    if has_shard_map():
+        assert JaxWorkBackend(kernel="xla", mesh_devices=1).mesh is not None
+    else:
+        with pytest.raises(WorkError, match="shard_map"):
+            JaxWorkBackend(kernel="xla", mesh_devices=1)
+
+
+def test_finds_planted_nonce_in_any_shard(gang):
     """A solution planted in each chip's sub-range is found with the correct
     global offset — the disjoint-range split leaves no gaps or overlaps."""
     h = bytes(range(32))
     base = 1 << 40
-    n = mesh.shape[NONCE_AXIS]
+    n = len(jax.devices())
     for shard in range(n):
         offset = shard * CHUNK + (CHUNK // 2)
         nonce = base + offset
         diff = _plant_solution(h, nonce)
-        params = replicate_params(_params(h, diff, base), mesh)
-        out = sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=CHUNK)
+        out = gang_chunk_batch(gang, _params(h, diff, base), chunk_per_shard=CHUNK)
         got = int(np.asarray(out)[0])
         assert got <= offset, f"shard {shard}: missed or overshot ({got})"
         # whatever offset won must itself be valid at that difficulty
@@ -75,10 +151,9 @@ def test_finds_planted_nonce_in_any_shard(mesh):
         assert _plant_solution(h, won) >= diff
 
 
-@requires_shard_map
-def test_winner_election_picks_global_minimum(mesh):
-    """Two planted solutions in different shards: pmin elects the lower
-    offset — deterministic, unlike the reference's first-message race."""
+def test_winner_election_picks_global_minimum(gang):
+    """Two planted solutions in different shards: the election picks the
+    lower offset — deterministic, unlike the reference's first-message race."""
     h = secrets.token_bytes(32)
     base = 7 << 33
     lo_off = 2 * CHUNK + 17  # shard 2
@@ -86,37 +161,32 @@ def test_winner_election_picks_global_minimum(mesh):
     d_lo = _plant_solution(h, base + lo_off)
     d_hi = _plant_solution(h, base + hi_off)
     diff = min(d_lo, d_hi)
-    params = replicate_params(_params(h, diff, base), mesh)
-    out = sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=CHUNK)
+    out = gang_chunk_batch(gang, _params(h, diff, base), chunk_per_shard=CHUNK)
     got = int(np.asarray(out)[0])
     assert got <= lo_off
     assert _plant_solution(h, search.nonce_from_offset(base, got)) >= diff
 
 
-@requires_shard_map
-def test_dry_window_returns_sentinel(mesh):
-    params = replicate_params(_params(bytes(32), (1 << 64) - 1, 123), mesh)
-    out = sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=CHUNK)
+def test_dry_window_returns_sentinel(gang):
+    out = gang_chunk_batch(
+        gang, _params(bytes(32), (1 << 64) - 1, 123), chunk_per_shard=CHUNK
+    )
     assert int(np.asarray(out)[0]) == int(search.SENTINEL)
 
 
-@requires_shard_map
-def test_matches_single_chip_scan(mesh):
+def test_matches_single_chip_scan(gang):
     """The ganged window must equal one big single-chip window bit-for-bit."""
     h = secrets.token_bytes(32)
     base = secrets.randbits(64)
-    n = mesh.shape[NONCE_AXIS]
+    n = len(jax.devices())
     diff = 0xFFF0000000000000  # easy enough for hits in a small window
     p = _params(h, diff, base)
-    ganged = sharded_search_chunk_batch(
-        replicate_params(p, mesh), mesh=mesh, chunk_per_shard=CHUNK
-    )
+    ganged = gang_chunk_batch(gang, p, chunk_per_shard=CHUNK)
     single = search.search_chunk_batch(jax.numpy.asarray(p), chunk_size=CHUNK * n)
     assert int(np.asarray(ganged)[0]) == int(np.asarray(single)[0])
 
 
-@requires_shard_map
-def test_batched_requests_independent(mesh):
+def test_batched_requests_independent(gang):
     """Batch lanes are independent: planted hit in lane 0, dry lane 1."""
     h0, h1 = secrets.token_bytes(32), secrets.token_bytes(32)
     base = 99
@@ -127,43 +197,42 @@ def test_batched_requests_independent(mesh):
             search.pack_params(h1, (1 << 64) - 1, base),
         ]
     )
-    params = replicate_params(rows, mesh)
-    out = np.asarray(
-        sharded_search_chunk_batch(params, mesh=mesh, chunk_per_shard=CHUNK)
-    )
+    out = np.asarray(gang_chunk_batch(gang, rows, chunk_per_shard=CHUNK))
     assert int(out[0]) <= 10
     assert int(out[1]) == int(search.SENTINEL)
 
 
-@requires_shard_map
-def test_batch_sharded_mesh(mesh):
-    """2D mesh (batch=4, nonce=2): requests spread across chip groups."""
-    m = make_mesh(jax.devices(), batch_shards=4)
+def test_batch_rows_on_partial_gang(gang):
+    """Multiple requests on a 2-device gang (the mesh's (batch=4, nonce=2)
+    shape; the fan's equivalent is every row fanned over the same 2
+    devices): all rows solve independently."""
     h = secrets.token_bytes(32)
     base = 5000
     diff = _plant_solution(h, base + 3)
     rows = np.stack([search.pack_params(h, diff, base) for _ in range(4)])
-    out = np.asarray(
-        sharded_search_chunk_batch(
-            replicate_params(rows, m), mesh=m, chunk_per_shard=CHUNK
+    if gang == "fan":
+        out = np.asarray(
+            gang_chunk_batch(gang, rows, chunk_per_shard=CHUNK, n_devices=2)
         )
-    )
+    else:
+        m = make_mesh(jax.devices(), batch_shards=4)
+        out = np.asarray(
+            sharded_search_chunk_batch(
+                replicate_params(rows, m), mesh=m, chunk_per_shard=CHUNK
+            )
+        )
     assert all(int(o) <= 3 for o in out)
 
 
-@requires_shard_map
-def test_sharded_search_run_to_solution(mesh):
-    """The device-resident while_loop runs windows until a real solution at a
+def test_gang_search_run_to_solution(gang):
+    """The multi-step run path covers windows until a real solution at a
     moderate difficulty, and the winning nonce validates via hashlib."""
     h = secrets.token_bytes(32)
     diff = 0xFFFC000000000000  # ~2^14 expected hashes: a few tiny windows
     p = _params(h, diff, secrets.randbits(64))
-    steps = expected_steps(diff, chunk_per_shard=CHUNK, n_nonce=mesh.shape[NONCE_AXIS])
-    lo, hi = sharded_search_run(
-        replicate_params(p, mesh),
-        mesh=mesh,
-        chunk_per_shard=CHUNK,
-        max_steps=max(steps * 8, 64),
+    steps = expected_steps(diff, chunk_per_shard=CHUNK, n_nonce=len(jax.devices()))
+    lo, hi = gang_run(
+        gang, p, chunk_per_shard=CHUNK, max_steps=max(steps * 8, 64)
     )
     nonce = (int(np.asarray(hi)[0]) << 32) | int(np.asarray(lo)[0])
     assert nonce != (1 << 64) - 1, "search did not converge"
@@ -171,8 +240,7 @@ def test_sharded_search_run_to_solution(mesh):
     assert nc.work_value(h.hex(), work) >= diff
 
 
-@requires_shard_map
-def test_sharded_pallas_multiblock_matches_xla(mesh):
+def test_gang_pallas_multiblock_matches_xla(gang):
     """Persistent-kernel mode per shard (nblocks>1, group>1) must return the
     same winner as the plain XLA scanner over the identical ganged window —
     the multi-chip path may not change semantics when it amortizes dispatch
@@ -181,50 +249,44 @@ def test_sharded_pallas_multiblock_matches_xla(mesh):
     chunk = sub * 128 * it * nb  # 8192 per shard
     h = secrets.token_bytes(32)
     base = 3 << 20
-    n = mesh.shape[NONCE_AXIS]
+    n = len(jax.devices())
     # Plant the winner inside the SECOND window of a middle shard, so the
     # hit requires the in-dispatch window advance to be offset-correct.
     shard = min(2, n - 1)
     offset = shard * chunk + sub * 128 * it + 37
     diff = _plant_solution(h, base + offset)
     p = _params(h, diff, base)
-    pall = sharded_search_chunk_batch(
-        replicate_params(p, mesh), mesh=mesh, chunk_per_shard=chunk,
-        kernel="pallas", sublanes=sub, iters=it, nblocks=nb, group=grp,
-        interpret=True,
+    pall = gang_chunk_batch(
+        gang, p, chunk_per_shard=chunk, kernel="pallas", sublanes=sub,
+        iters=it, nblocks=nb, group=grp, interpret=True,
     )
-    xla = sharded_search_chunk_batch(
-        replicate_params(p, mesh), mesh=mesh, chunk_per_shard=chunk
-    )
+    xla = gang_chunk_batch(gang, p, chunk_per_shard=chunk)
     got = int(np.asarray(pall)[0])
     assert got == int(np.asarray(xla)[0])
     assert got <= offset
     assert _plant_solution(h, search.nonce_from_offset(base, got)) >= diff
 
 
-def test_sharded_pallas_geometry_mismatch_rejected(mesh):
+def test_gang_pallas_geometry_mismatch_rejected(gang):
     with pytest.raises(ValueError):
-        sharded_search_chunk_batch(
-            replicate_params(_params(bytes(32), 1, 0), mesh),
-            mesh=mesh, chunk_per_shard=1024,
+        gang_chunk_batch(
+            gang, _params(bytes(32), 1, 0), chunk_per_shard=1024,
             kernel="pallas", sublanes=8, iters=4, nblocks=2, interpret=True,
         )
 
 
-@requires_shard_map
-def test_sharded_run_pallas_multiblock_to_solution(mesh):
-    """sharded_search_run with the persistent-kernel geometry converges and
-    the winning nonce validates — the flagship 8-chip latency configuration
-    end-to-end on the virtual mesh."""
+def test_gang_run_pallas_multiblock_to_solution(gang):
+    """The run path with the persistent-kernel geometry converges and the
+    winning nonce validates — the flagship 8-chip latency configuration
+    end-to-end on the virtual devices."""
     sub, it, nb = 8, 2, 2
     chunk = sub * 128 * it * nb
     h = secrets.token_bytes(32)
     diff = 0xFFFC000000000000  # ~2^14 expected hashes
     p = _params(h, diff, secrets.randbits(64))
-    lo, hi = sharded_search_run(
-        replicate_params(p, mesh), mesh=mesh, chunk_per_shard=chunk,
-        max_steps=32, kernel="pallas", sublanes=sub, iters=it, nblocks=nb,
-        group=2, interpret=True,
+    lo, hi = gang_run(
+        gang, p, chunk_per_shard=chunk, max_steps=32, kernel="pallas",
+        sublanes=sub, iters=it, nblocks=nb, group=2, interpret=True,
     )
     nonce = (int(np.asarray(hi)[0]) << 32) | int(np.asarray(lo)[0])
     assert nonce != (1 << 64) - 1, "search did not converge"
@@ -232,17 +294,14 @@ def test_sharded_run_pallas_multiblock_to_solution(mesh):
     assert nc.work_value(h.hex(), work) >= diff
 
 
-def test_global_chunk_cap_enforced(mesh):
+def test_global_chunk_cap_enforced(gang):
     with pytest.raises(ValueError):
-        sharded_search_chunk_batch(
-            replicate_params(_params(bytes(32), 1, 0), mesh),
-            mesh=mesh,
-            chunk_per_shard=1 << 30,
+        gang_chunk_batch(
+            gang, _params(bytes(32), 1, 0), chunk_per_shard=1 << 30
         )
 
 
-@requires_shard_map
-def test_sharded_run_active_mask_skips_padding(mesh):
+def test_gang_run_active_mask_skips_padding(gang):
     """Padding rows (unreachable difficulty, active=False) must not hold the
     device-resident while_loop at max_steps once real rows have solved."""
     h = secrets.token_bytes(32)
@@ -252,19 +311,34 @@ def test_sharded_run_active_mask_skips_padding(mesh):
             _params(bytes(32), (1 << 64) - 1, 0)[0],  # engine batch padding
         ]
     )
-    lo, hi = sharded_search_run(
-        replicate_params(rows, mesh),
-        jnp.array([True, False]),
-        mesh=mesh,
-        chunk_per_shard=CHUNK,
+    lo, hi = gang_run(
+        gang, rows, np.array([True, False]), chunk_per_shard=CHUNK,
         max_steps=256,
     )
-    lo, hi = np.asarray(lo), np.asarray(hi)
     solved = (int(hi[0]) << 32) | int(lo[0])
     assert solved != (1 << 64) - 1
     work = search.work_hex_from_nonce(solved)
     assert nc.work_value(h.hex(), work) >= 0xFFF0000000000000
     assert int(lo[1]) == 0xFFFFFFFF and int(hi[1]) == 0xFFFFFFFF
+
+
+@requires_fan_devices
+def test_fan_matches_shard_map_contract_on_partial_width(gang):
+    """A 4-device gang (half the complement) still tiles its window with no
+    gaps: a nonce planted in the LAST device's sub-range is found. Pins the
+    width parameter end to end on both implementations."""
+    h = secrets.token_bytes(32)
+    base = 77
+    planted = base + 3 * CHUNK + 9  # fourth shard's sub-range
+    diff = _plant_solution(h, planted)
+    out = np.asarray(
+        gang_chunk_batch(
+            gang, _params(h, diff, base), chunk_per_shard=CHUNK, n_devices=4
+        )
+    )
+    off = int(out[0])
+    assert off != 0xFFFFFFFF and off <= planted - base
+    assert nc.work_value(h.hex(), search.work_hex_from_nonce(base + off)) >= diff
 
 
 # -- multi-host topology (parallel/multihost.py) --------------------------

@@ -61,6 +61,7 @@ import numpy as np
 from .. import obs
 from ..models import WorkRequest
 from ..ops import pallas_kernel, search
+from ..resilience.clock import Clock, SystemClock
 from ..utils import nanocrypto as nc
 from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
 
@@ -96,6 +97,21 @@ class _Job:
     base: int
     cancelled: bool = False
     waiters: int = 0  # refcount: last cancelled waiter drops the job
+    # Device fan (engine ``devices`` mode): per-device shard state. The
+    # engine sub-partitions the job's nonce range into disjoint per-device
+    # sub-ranges (the fleet partition idiom one level down); each device
+    # keeps its own frontier, scan counter and scan-clock stamp so a win
+    # can be attributed to the device whose sub-range produced it.
+    dev_bases: "Optional[list]" = None  # split policy: per-device next base
+    dev_scanned: "Optional[list]" = None  # nonces scanned per device (this job)
+    dev_t0: "Optional[list]" = None  # per-device scan-clock first-dispatch stamps
+    # Bumped on every re-aim of the scan — fan re-partitions AND plain
+    # cover_range rebases — so results of launches dispatched against the
+    # OLD region cannot feed the new partition's scan counters/clocks, and
+    # a stale launch's weak hit cannot rewind the frontier back into the
+    # region a re-cover just left (the same inflation/undo the fleet's
+    # per-shard scan stamps guard against).
+    dev_epoch: int = 0
     # P(no launch currently in flight solves this job); 1.0 = uncovered.
     inflight_miss: float = 1.0
     # Timeline stamps (record_timeline only): submission and first dispatch.
@@ -137,6 +153,10 @@ class _Launch:
     span: int  # nonces scanned per row this launch
     shape: tuple  # (batch, steps) — warmed on success
     miss_factors: list  # per-job P(this span misses), undone when applied
+    # Fan mode: per-job per-device base snapshot [len(jobs)][n_devices] and
+    # the partition epoch each job was packed under — the attribution keys.
+    dev_bases: "Optional[list]" = None
+    dev_epochs: "Optional[list]" = None
     timing: "Optional[dict]" = None  # stage stamps when record_timeline is on
     # Readback-await task, created when this launch reaches the head of the
     # FIFO; persists across wakeup-interrupted waits (engine loop).
@@ -146,13 +166,23 @@ class _Launch:
 class JaxWorkBackend(WorkBackend):
     """Batched chunked nonce search on this host's jax.local_devices().
 
-    ``mesh_devices`` >= 1 gangs that many devices onto every hash through the
-    (batch, nonce) mesh of parallel/mesh_search.py — the flagship latency
-    configuration: the <50 ms p50 target at difficulty fffffff800000000
-    needs all 8 chips of a v5e-8 on one request (SURVEY.md §7 hard part #3).
-    The per-dispatch window then covers mesh_devices * chunk nonces, and the
-    winner election is an ICI pmin instead of the reference's MQTT
-    result/cancel round-trip.
+    Two multi-chip flavors gang local devices onto every hash — the
+    flagship latency configuration: the <50 ms p50 target at difficulty
+    fffffff800000000 needs all 8 chips of a v5e-8 on one request
+    (SURVEY.md §7 hard part #3). The per-dispatch window covers
+    N_devices * chunk nonces either way:
+
+    * ``devices`` >= 1 — the pmap FAN (parallel/fan_search.py,
+      docs/device_sharding.md): shard_map-free, runs on every supported
+      jax. Each job's nonce shard is sub-partitioned into disjoint
+      per-device ranges (``device_shard`` policy: 'split' macro-ranges /
+      'interleave' round-robin windows); the host elects the winner and
+      attributes it to the device whose sub-range produced it, feeding
+      per-device scan clocks + EMA (the fleet registry idiom one level
+      down). Cancel/raise/cover_range apply to every device shard.
+    * ``mesh_devices`` >= 1 — the shard_map (batch, nonce) mesh of
+      parallel/mesh_search.py with an ICI pmin election; needs jax >= 0.6
+      (capability-gated) and stays the fast path there.
     """
 
     def __init__(
@@ -166,14 +196,32 @@ class JaxWorkBackend(WorkBackend):
         max_batch: int = 16,
         interpret: bool = False,
         device: Optional[jax.Device] = None,
-        mesh_devices: int = 0,  # >=1: gang this many devices per hash
+        mesh_devices: int = 0,  # >=1: gang this many devices per hash (shard_map)
+        devices: int = 0,  # >=1: fan this many local devices per hash (pmap)
+        device_shard: str = "split",  # fan partition policy: 'split' | 'interleave'
         run_steps: Optional[int] = None,  # cap on windows per device launch
         warm_shapes: Optional[bool] = None,  # background-compile launch shapes
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
         pipeline: int = 2,  # launches in flight at once (1 = no overlap)
         step_ladder: str = "x4",  # run-length quantization: 'x4' | 'x2'
         shared_steps_cap: Optional[int] = None,  # windows/launch under contention
+        clock: Optional[Clock] = None,  # fan scan clocks / busy-fraction wall
     ):
+        # Injectable time for the fan's per-device scan clocks and the
+        # busy-fraction wall anchor (resilience/clock.py): chaos/FakeClock
+        # tests drive EMA attribution without sleeping through real seconds.
+        self._clock = clock or SystemClock()
+        if devices and mesh_devices >= 1:
+            raise WorkError(
+                "devices (pmap fan) and mesh_devices (shard_map gang) are "
+                "mutually exclusive — pick one multi-device path"
+            )
+        if device_shard not in ("split", "interleave"):
+            raise WorkError(
+                f"device_shard must be 'split' or 'interleave', not {device_shard!r}"
+            )
+        self.device_shard = device_shard
+        self.fan = None
         if mesh_devices >= 1:
             # 0 (default) = plain single-device dispatch. >= 1 builds the
             # shard_map gang — INCLUDING 1: a one-device mesh runs the
@@ -187,16 +235,37 @@ class JaxWorkBackend(WorkBackend):
             # per-worker gang must only claim this host's chips (ICI
             # domain); cross-host scale is the broker swarm's job, or an
             # SPMD deployment over parallel/multihost.py's mesh.
-            devices = jax.local_devices()
-            if len(devices) < mesh_devices:
+            local = jax.local_devices()
+            if len(local) < mesh_devices:
                 raise WorkError(
-                    f"mesh_devices={mesh_devices} but only {len(devices)} "
+                    f"mesh_devices={mesh_devices} but only {len(local)} "
                     "local devices visible"
                 )
-            from ..parallel import make_mesh
+            from ..parallel import has_shard_map, make_mesh
 
-            self.mesh = make_mesh(devices[:mesh_devices])
-            self.device = devices[0]
+            if not has_shard_map():
+                raise WorkError(
+                    f"this jax ({jax.__version__}) has no jax.shard_map "
+                    "(promoted in 0.6) — the mesh gang cannot run; use "
+                    f"devices={mesh_devices} for the shard_map-free pmap fan"
+                )
+            self.mesh = make_mesh(local[:mesh_devices])
+            self.device = local[0]
+        elif devices:
+            # The shard_map-free multi-device path (parallel/fan_search.py):
+            # one WorkRequest's nonce shard is sub-partitioned into disjoint
+            # per-device ranges and searched on `devices` local chips via
+            # pmap — every primitive exists on jax 0.4.37. -1 = all local
+            # devices; 1 builds the real fan on one device (the A/B that
+            # prices the fan machinery, same idiom as mesh_devices=1).
+            from ..parallel import fan_devices
+
+            try:
+                self.fan = fan_devices(devices)
+            except ValueError as e:
+                raise WorkError(str(e))
+            self.mesh = None
+            self.device = self.fan[0]
         else:
             self.mesh = None
             self.device = device or jax.local_devices()[0]
@@ -217,7 +286,11 @@ class JaxWorkBackend(WorkBackend):
             self.nblocks = 1
             self.group = 1
         self.chunk_per_shard = self.sublanes * 128 * self.iters * self.nblocks
-        self.chunk = self.chunk_per_shard * (mesh_devices if self.mesh else 1)
+        # Global per-step window: every gang flavor (shard_map mesh, pmap
+        # fan) multiplies the per-device chunk by its width; the host loop
+        # advances one logical frontier by the global chunk either way.
+        gang_width = mesh_devices if self.mesh else (len(self.fan) if self.fan else 1)
+        self.chunk = self.chunk_per_shard * gang_width
         # Run mode: one launch may widen to run_steps consecutive windows in
         # a single persistent-kernel grid dispatch with cross-window early
         # exit. The cap bounds cancel latency: a launch cannot be
@@ -344,6 +417,41 @@ class JaxWorkBackend(WorkBackend):
         self._m_hash_rate = reg.gauge(
             "dpow_engine_hash_rate_hs",
             "Scan rate of the most recently applied launch (H/s)", ("engine",))
+        # Per-device families (fan mode; docs/observability.md catalogue).
+        # Label cardinality is the local device count (<= 8 on every target
+        # topology), never unbounded.
+        self._m_dev_rate = reg.gauge(
+            "dpow_backend_device_hash_rate_hs",
+            "Per-device scan rate of the most recently applied fanned "
+            "launch (H/s)", ("device",))
+        self._m_dev_launches = reg.counter(
+            "dpow_backend_device_launches_total",
+            "Fanned launches applied, per device", ("device",))
+        self._m_dev_hashes = reg.counter(
+            "dpow_backend_device_hashes_total",
+            "Nonces scanned per device across fanned launches", ("device",))
+        self._m_dev_busy = reg.gauge(
+            "dpow_backend_device_busy_fraction",
+            "Fraction of wall time the device spent executing fanned "
+            "launches (occupancy)", ("device",))
+        self._m_dev_wins = reg.counter(
+            "dpow_backend_device_wins_total",
+            "Wins attributed to the device whose sub-range produced the "
+            "nonce", ("device",))
+        self._m_dev_ema = reg.gauge(
+            "dpow_backend_device_ema_hs",
+            "EMA of win-attributed scan rate on the device's own scan "
+            "clock (H/s)", ("device",))
+        # Fan bookkeeping: per-device busy seconds + EMA folds, the wall
+        # anchor for busy-fraction, and the last win's attribution record
+        # (device index, hashes, scan-clock elapsed) — the engine-level
+        # twin of the fleet registry's observe_result sample.
+        n_fan = len(self.fan) if self.fan else 0
+        self._fan_wall_t0 = self._clock.time()
+        self._dev_busy = [0.0] * n_fan
+        self.device_ema = [0.0] * n_fan
+        self.fan_ema_alpha = 0.3  # same fold as fleet/registry.py
+        self.last_win: Optional[dict] = None
 
     # -- WorkBackend interface -------------------------------------------
 
@@ -353,9 +461,12 @@ class JaxWorkBackend(WorkBackend):
         # the one-time jit compile cost off the event loop.
         probe = search.pack_params(bytes(32), 1, base=0)
         lo, hi = await self._timed_launch(np.stack([probe]), 1)
-        if int(lo[0]) != 0 or int(hi[0]) != 0:
+        # Fan mode returns per-device arrays; flat[0] is device 0 / row 0
+        # either way, and device 0's sub-range starts at the probe base.
+        if int(lo.flat[0]) != 0 or int(hi.flat[0]) != 0:
             raise WorkError(
-                f"backend self-test failed (nonce {int(hi[0]):08x}{int(lo[0]):08x})"
+                f"backend self-test failed "
+                f"(nonce {int(hi.flat[0]):08x}{int(lo.flat[0]):08x})"
             )
         self._warm.add((1, 1))
         if self.run_steps > 1 and not self.warm_shapes:
@@ -405,9 +516,13 @@ class JaxWorkBackend(WorkBackend):
         # shard holds no solution (the server re-covers dead shards; a live
         # worker overrunning into a neighbor's shard is just redundancy).
         if request.nonce_range is not None:
-            job.set_base(request.nonce_range[0])
+            start, length = request.nonce_range
         else:
-            job.set_base(secrets.randbits(64))
+            start, length = secrets.randbits(64), 0
+        if self.fan is not None:
+            self._fan_partition(job, start, length)
+        else:
+            job.set_base(start)
         self._jobs[key] = job
         self._ensure_engine()
         self._wakeup.set()
@@ -447,7 +562,18 @@ class JaxWorkBackend(WorkBackend):
         job = self._jobs.get(nc.validate_block_hash(block_hash))
         if job is None or job.cancelled or job.future.done():
             return False
-        job.set_base(nonce_range[0])
+        if self.fan is not None:
+            # EVERY device shard rebases into the new range (the epoch bump
+            # inside _fan_partition keeps old-partition launches still on
+            # the wire from feeding the new shards' counters/clocks).
+            self._fan_partition(job, nonce_range[0], nonce_range[1])
+        else:
+            job.set_base(nonce_range[0])
+            # Same staleness fence as the fan: a launch already on the wire
+            # was aimed at the OLD region — its weak hit (raised-target
+            # race, _apply_plain_rows) must not rewind the frontier out of
+            # the range this re-cover just claimed.
+            job.dev_epoch += 1
         job.inflight_miss = 1.0
         self._wakeup.set()
         return True
@@ -622,8 +748,14 @@ class JaxWorkBackend(WorkBackend):
 
         def timed():  # stamps the executor-queue and device stages
             timing["t_thread"] = time.perf_counter()
+            # Injectable-clock twin of the device-time stamps: the fan's
+            # busy-fraction gauge divides busy by wall measured on the SAME
+            # clock (SystemClock: identical to the perf stamps; FakeClock:
+            # deterministic, advanced only by the test).
+            timing["t_thread_clock"] = self._clock.time()
             out = self._launch(params_batch, steps)
             timing["t_done"] = time.perf_counter()
+            timing["t_done_clock"] = self._clock.time()
             return out
 
         return loop.run_in_executor(self._executor, timed)
@@ -665,6 +797,32 @@ class JaxWorkBackend(WorkBackend):
         window hits.
         """
         nblocks = self.nblocks * steps
+        if self.fan is not None:
+            from ..parallel import fan_search_devices
+
+            n = len(self.fan)
+            span_dev = self.chunk_per_shard * steps
+            if params_batch.ndim == 2:
+                # Bare rows (setup self-test, warm probes): interleave from
+                # each row's own base so the fan covers a contiguous window.
+                params_batch = self._fan_stack_probe(params_batch, n, span_dev)
+            offs = fan_search_devices(
+                params_batch,
+                devices=self.fan,
+                chunk_per_shard=span_dev,
+                kernel=self.kernel,
+                sublanes=self.sublanes,
+                iters=self.iters,
+                nblocks=nblocks,
+                group=self.group,
+                interpret=self.interpret,
+            )
+            flat_p = params_batch.reshape(-1, search.PARAMS_LEN)
+            lo, hi = self._offsets_to_nonces(flat_p, offs.reshape(-1))
+            # Per-device absolute nonces [n_dev, B] (all-ones where that
+            # device's span was dry); the host elects the winner against
+            # the launch's base snapshot and keeps the attribution.
+            return lo.reshape(offs.shape), hi.reshape(offs.shape)
         if self.mesh is not None:
             from ..parallel import replicate_params, sharded_search_chunk_batch
 
@@ -725,6 +883,83 @@ class JaxWorkBackend(WorkBackend):
         for i in range(b):
             out[i] = jobs[i].params if i < len(jobs) else JaxWorkBackend._PAD_ROW
         return out
+
+    # -- device fan (devices >= 1) ----------------------------------------
+
+    def _fan_partition(self, job: _Job, start: int, length: int) -> None:
+        """Sub-partition ``[start, start+length)`` (length 0 = full 2^64
+        span) across the fan — the fleet partition idiom one level down.
+
+        'split' gives each device a contiguous macro-range (its own shard:
+        per-device frontier, scan counter and scan clock — EMA attribution
+        mirrors the fleet's (nonces from shard start)/(elapsed) formula).
+        'interleave' keeps ONE frontier and deals consecutive per-launch
+        windows round-robin (device d takes the d-th window of every
+        launch), which matches the mesh gang's coverage order exactly.
+        Ends are soft either way, like fleet shards: a device may overrun
+        into its neighbor's sub-range rather than strand a dispatch whose
+        shard holds no solution.
+        """
+        n = len(self.fan)
+        job.set_base(start)
+        if self.device_shard == "split":
+            stride = max((length or (1 << 64)) // n, 1)
+            job.dev_bases = [(start + d * stride) & _MASK64 for d in range(n)]
+        else:
+            job.dev_bases = None  # derived from the frontier at pack time
+        job.dev_scanned = [0] * n
+        job.dev_t0 = None  # stamped at the first dispatch of this partition
+        job.dev_epoch += 1
+
+    def _fan_launch_bases(self, job: _Job, span_dev: int) -> list:
+        """This launch's per-device bases for one job (pre-advance)."""
+        if job.dev_bases is not None:  # split: each device's own frontier
+            return list(job.dev_bases)
+        # interleave: consecutive windows of the single frontier
+        return [(job.base + d * span_dev) & _MASK64 for d in range(len(self.fan))]
+
+    def _fan_advance(self, job: _Job, span_dev: int) -> None:
+        """Speculative frontier advance at dispatch (all device shards)."""
+        if job.dev_bases is not None:
+            job.dev_bases = [
+                (b + span_dev) & _MASK64 for b in job.dev_bases
+            ]
+        else:
+            job.set_base(job.base + span_dev * len(self.fan))
+
+    def _fan_stack(self, jobs: list, b: int, steps: int) -> tuple:
+        """Fan batch: uint32[n_dev, b, 12] plus the per-job base snapshot.
+
+        Row content matches _pack (active jobs + difficulty-0 padding);
+        each device's slice carries that device's base words. Padding rows
+        hit at offset 0 on every device and early-exit, exactly as on the
+        single-device path.
+        """
+        n = len(self.fan)
+        span_dev = self.chunk_per_shard * steps
+        rows = self._pack(jobs, b)
+        stacked = np.repeat(rows[None], n, axis=0)
+        snap = []
+        for i, job in enumerate(jobs):
+            bases = self._fan_launch_bases(job, span_dev)
+            snap.append(bases)
+            for d, base in enumerate(bases):
+                stacked[d, i, search.BASE_LO] = base & 0xFFFFFFFF
+                stacked[d, i, search.BASE_HI] = base >> 32
+        return stacked, snap
+
+    @staticmethod
+    def _fan_stack_probe(params_batch: np.ndarray, n: int, span_dev: int) -> np.ndarray:
+        """Stack bare rows (setup/warm probes) with interleaved bases."""
+        stacked = np.repeat(params_batch[None], n, axis=0)
+        base_lo = params_batch[:, search.BASE_LO].astype(np.uint64)
+        base_hi = params_batch[:, search.BASE_HI].astype(np.uint64)
+        bases = (base_hi << np.uint64(32)) | base_lo
+        for d in range(n):
+            nb = (bases + np.uint64(d) * np.uint64(span_dev)) & np.uint64(_MASK64)
+            stacked[d, :, search.BASE_LO] = (nb & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            stacked[d, :, search.BASE_HI] = (nb >> np.uint64(32)).astype(np.uint32)
+        return stacked
 
     def _next_rung(self, rungs: Dict[int, list]) -> int:
         """Next difficulty rung to serve, round-robin by run length.
@@ -845,8 +1080,12 @@ class JaxWorkBackend(WorkBackend):
             active = pool[: self.max_batch]
         b, steps = self._pick_shape(len(active), steps_want)
         active = active[:b]
-        params = self._pack(active, b)
-        span = self.chunk * steps
+        dev_snap = None
+        if self.fan is not None:
+            params, dev_snap = self._fan_stack(active, b, steps)
+        else:
+            params = self._pack(active, b)
+        span = self.chunk * steps  # global: every device's sub-span summed
         factors = [self._miss_factor(j.difficulty, span) for j in active]
         # Timing stamps the PHYSICAL queue depth: the overhead
         # decomposition buckets head-vs-successor device time by
@@ -865,6 +1104,10 @@ class JaxWorkBackend(WorkBackend):
             if not j.t_first_dispatch:
                 j.t_first_dispatch = timing["t_dispatch"]
                 self._tracer.mark_hash(j.block_hash, "pack")
+            if self.fan is not None and j.dev_t0 is None:
+                # Per-device scan clocks start at the partition's first
+                # dispatch (all devices launch together in one fan pack).
+                j.dev_t0 = [self._clock.time()] * len(self.fan)
         rec = _Launch(
             fut=self._submit_launch(params, steps, timing),
             jobs=active,
@@ -874,19 +1117,27 @@ class JaxWorkBackend(WorkBackend):
             launched_difficulty=[j.difficulty for j in active],
             bases=[j.base for j in active],
             span=span,
-            shape=(params.shape[0], steps),
+            shape=(b, steps),
             miss_factors=factors,
             timing=timing,
+            dev_bases=dev_snap,
+            # Both paths snapshot the re-aim epoch: the apply paths use it
+            # to fence stale launches out of frontier rewinds (plain) and
+            # shard counters/clocks (fan).
+            dev_epochs=[j.dev_epoch for j in active],
         )
+        span_dev = self.chunk_per_shard * steps
         for job, f in zip(active, factors):
-            job.set_base(job.base + span)
+            if self.fan is not None:
+                self._fan_advance(job, span_dev)
+            else:
+                job.set_base(job.base + span)
             job.inflight_miss *= f
         return rec
 
     def _apply_results(self, rec: "_Launch", lo_arr, hi_arr) -> None:
         self._warm.add(rec.shape)  # organic warming
         timing = rec.timing
-        applied_hashes = 0
         if timing is not None:
             timing["t_apply"] = time.perf_counter()
             timing["batch"], timing["steps"] = rec.shape
@@ -904,8 +1155,43 @@ class JaxWorkBackend(WorkBackend):
             # (clamped — repeated multiply/divide may drift past 1.0).
             job.inflight_miss = min(1.0, job.inflight_miss / f)
             job.applied_launches += 1
-        for job, launched, base, lo, hi in zip(
-            rec.jobs, rec.launched_difficulty, rec.bases,
+        if rec.dev_bases is not None:
+            applied_hashes = self._apply_fan_rows(rec, lo_arr, hi_arr)
+        else:
+            applied_hashes = self._apply_plain_rows(rec, lo_arr, hi_arr)
+        self._m_hashes.inc(applied_hashes, "jax")
+        if timing is not None and timing.get("t_done", 0.0) > timing.get(
+            "t_thread", 0.0
+        ):
+            self._m_hash_rate.set(
+                applied_hashes / (timing["t_done"] - timing["t_thread"]), "jax"
+            )
+
+    def _record_solve(self, job: _Job, work: str) -> None:
+        """Shared per-solve bookkeeping (plain and fan apply paths)."""
+        self.total_solutions += 1
+        self._m_solutions.inc(1, "jax")
+        self._tracer.mark_hash(job.block_hash, "device")
+        if job.t_submit:
+            self._m_queue_wait.observe(
+                max(0.0, job.t_first_dispatch - job.t_submit), "jax"
+            )
+        job.future.set_result(work)
+        if self.record_timeline and job.t_submit:
+            now = time.perf_counter()
+            self.timeline.append((
+                "solve",
+                {
+                    "queue_wait": job.t_first_dispatch - job.t_submit,
+                    "total": now - job.t_submit,
+                    "launches": job.applied_launches,
+                },
+            ))
+
+    def _apply_plain_rows(self, rec: "_Launch", lo_arr, hi_arr) -> int:
+        applied_hashes = 0
+        for job, launched, base, epoch, lo, hi in zip(
+            rec.jobs, rec.launched_difficulty, rec.bases, rec.dev_epochs,
             lo_arr[: len(rec.jobs)], hi_arr[: len(rec.jobs)],
         ):
             nonce = (int(hi) << 32) | int(lo)
@@ -923,31 +1209,18 @@ class JaxWorkBackend(WorkBackend):
             work = search.work_hex_from_nonce(nonce)
             value = nc.work_value(job.block_hash, work)
             if value >= job.difficulty:
-                self.total_solutions += 1
-                self._m_solutions.inc(1, "jax")
-                self._tracer.mark_hash(job.block_hash, "device")
-                if job.t_submit:
-                    self._m_queue_wait.observe(
-                        max(0.0, job.t_first_dispatch - job.t_submit), "jax"
-                    )
-                job.future.set_result(work)
-                if self.record_timeline and job.t_submit:
-                    now = time.perf_counter()
-                    self.timeline.append((
-                        "solve",
-                        {
-                            "queue_wait": job.t_first_dispatch - job.t_submit,
-                            "total": now - job.t_submit,
-                            "launches": job.applied_launches,
-                        },
-                    ))
+                self._record_solve(job, work)
             elif value >= launched:
                 # Valid for the difficulty this chunk was launched at,
                 # but the target was raised mid-flight: keep searching
                 # past this nonce at the new difficulty. (An in-flight
                 # successor still scans its speculative span at the old
-                # target; a weaker hit there just lands back in this branch.)
-                job.set_base(nonce + 1)
+                # target; a weaker hit there just lands back in this
+                # branch.) Skipped when the job was re-aimed (cover_range)
+                # while this launch was on the wire — the rewind would
+                # drag the frontier back out of the re-covered range.
+                if epoch == job.dev_epoch:
+                    job.set_base(nonce + 1)
             else:  # device/host disagreement: a real bug, surface it
                 job.future.set_exception(
                     WorkError(
@@ -955,13 +1228,137 @@ class JaxWorkBackend(WorkBackend):
                         f"{job.block_hash} (value {value:016x} < {launched:016x})"
                     )
                 )
-        self._m_hashes.inc(applied_hashes, "jax")
-        if timing is not None and timing.get("t_done", 0.0) > timing.get(
-            "t_thread", 0.0
+        return applied_hashes
+
+    def _apply_fan_rows(self, rec: "_Launch", lo_arr, hi_arr) -> int:
+        """Apply one fanned launch: winner election + device attribution.
+
+        ``lo_arr``/``hi_arr`` are per-device absolute nonces [n_dev, B].
+        Per row, the hit scanned in the fewest nonces from its device's
+        launch base wins (the fan's "first" hit under equal scan rates —
+        deterministic, matching the mesh gang's pmin election); the win is
+        attributed to that device: its scan counter and scan clock produce
+        the EMA sample exactly the way the fleet registry attributes a
+        sharded win to the worker whose range contains the nonce.
+        """
+        n = len(self.fan)
+        span_dev = rec.span // n
+        applied_hashes = 0
+        per_dev_scanned = [0] * n
+        for i, (job, launched, bases, epoch) in enumerate(zip(
+            rec.jobs, rec.launched_difficulty, rec.dev_bases, rec.dev_epochs
+        )):
+            # Per-device results for this row: (local offset, device, nonce).
+            cands = []
+            row_scanned = [span_dev] * n
+            for d in range(n):
+                nonce = (int(hi_arr[d, i]) << 32) | int(lo_arr[d, i])
+                if nonce == _MASK64:
+                    continue  # this device's sub-span was dry
+                local = (nonce - bases[d]) & _MASK64
+                row_scanned[d] = local + 1
+                cands.append((local, d, nonce))
+            for d in range(n):
+                per_dev_scanned[d] += row_scanned[d]
+                applied_hashes += row_scanned[d]
+                self.total_hashes += row_scanned[d]
+                if job.dev_scanned is not None and epoch == job.dev_epoch:
+                    # Same-partition results only: a cover_range rebase
+                    # while this launch was on the wire reset the shard
+                    # counters, and the old span must not inflate them.
+                    job.dev_scanned[d] += row_scanned[d]
+            if job.future.done() or not cands:
+                continue
+            cands.sort()  # fewest-nonces-scanned first, device as tiebreak
+            for local, d, nonce in cands:
+                work = search.work_hex_from_nonce(nonce)
+                value = nc.work_value(job.block_hash, work)
+                if value >= job.difficulty:
+                    self._record_solve(job, work)
+                    self._attribute_win(job, d, epoch)
+                    break
+                elif value >= launched:
+                    # Valid at the launched target but raised mid-flight:
+                    # ONLY the device that produced the weak hit resumes
+                    # past it — its siblings' shards are untouched. Both
+                    # policies skip the rewind when the job was
+                    # re-partitioned while this launch was on the wire
+                    # (epoch mismatch): rewinding would drag the frontier
+                    # back into the OLD region and undo a cover_range
+                    # re-cover.
+                    if epoch == job.dev_epoch:
+                        if job.dev_bases is not None:
+                            job.dev_bases[d] = (nonce + 1) & _MASK64
+                        else:
+                            job.set_base(nonce + 1)
+                else:  # device/host disagreement: a real bug, surface it
+                    job.future.set_exception(
+                        WorkError(
+                            f"device produced invalid work {work} for "
+                            f"{job.block_hash} "
+                            f"(value {value:016x} < {launched:016x})"
+                        )
+                    )
+                    break
+        self._fan_update_device_metrics(rec, per_dev_scanned)
+        return applied_hashes
+
+    def _attribute_win(self, job: _Job, d: int, epoch: int) -> None:
+        """Fold one win into device d's EMA on ITS scan clock — the
+        engine-level twin of fleet/registry.py observe_result."""
+        if (
+            job.dev_scanned is None
+            or job.dev_t0 is None
+            or epoch != job.dev_epoch
         ):
-            self._m_hash_rate.set(
-                applied_hashes / (timing["t_done"] - timing["t_thread"]), "jax"
-            )
+            return
+        self._m_dev_wins.inc(1, str(d))
+        elapsed = self._clock.time() - job.dev_t0[d]
+        hashes = job.dev_scanned[d]
+        if elapsed <= 0.0 or hashes <= 0:
+            return
+        sample = hashes / elapsed
+        if self.device_ema[d] <= 0.0:
+            self.device_ema[d] = sample
+        else:
+            a = self.fan_ema_alpha
+            self.device_ema[d] = a * sample + (1.0 - a) * self.device_ema[d]
+        self._m_dev_ema.set(self.device_ema[d], str(d))
+        self.last_win = {
+            "device": d,
+            "hashes": hashes,
+            "elapsed": elapsed,
+            "sample_hs": sample,
+            "ema_hs": self.device_ema[d],
+        }
+
+    def _fan_update_device_metrics(
+        self, rec: "_Launch", per_dev_scanned: list
+    ) -> None:
+        timing = rec.timing or {}
+        # Physical device time (perf_counter) feeds the H/s rate — a
+        # hardware measure; busy-vs-wall rides the INJECTABLE clock on
+        # both sides, so the occupancy gauge is deterministic under
+        # FakeClock and honest under SystemClock.
+        dev_seconds = max(
+            0.0, timing.get("t_done", 0.0) - timing.get("t_thread", 0.0)
+        )
+        busy_clock = max(
+            0.0,
+            timing.get("t_done_clock", 0.0) - timing.get("t_thread_clock", 0.0),
+        )
+        wall = self._clock.time() - self._fan_wall_t0
+        for d, scanned in enumerate(per_dev_scanned):
+            label = str(d)
+            self._m_dev_launches.inc(1, label)
+            self._m_dev_hashes.inc(scanned, label)
+            if dev_seconds > 0.0:
+                self._m_dev_rate.set(scanned / dev_seconds, label)
+            self._dev_busy[d] += busy_clock
+            if wall > 0.0:
+                self._m_dev_busy.set(
+                    min(1.0, self._dev_busy[d] / wall), label
+                )
 
     async def _engine_loop_inner(self) -> None:
         inflight: deque = deque()

@@ -103,6 +103,8 @@ class DpowClient:
         elif name == "jax":
             kwargs["max_batch"] = config.max_batch
             kwargs["mesh_devices"] = config.mesh_devices
+            kwargs["devices"] = config.devices
+            kwargs["device_shard"] = config.device_shard
             if config.run_steps > 0:
                 kwargs["run_steps"] = config.run_steps
             if config.pipeline > 0:

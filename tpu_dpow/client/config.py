@@ -28,7 +28,16 @@ class ClientConfig:
     startup_heartbeat_wait: float = 2.0  # refuse to start without a live server
     reconnect_delay: float = 20.0
     max_batch: int = 16
-    mesh_devices: int = 0  # >=1: gang N local chips per hash; 0 = plain (backend=jax)
+    mesh_devices: int = 0  # >=1: gang N local chips per hash via shard_map (backend=jax)
+    # Shard_map-free device fan (tpu_dpow/parallel/fan_search.py): fan every
+    # WorkRequest's nonce shard across N local devices via pmap. 0 = plain
+    # single-device path; -1 = all local devices; 1 prices the fan machinery
+    # on one device (A/B). Mutually exclusive with mesh_devices.
+    devices: int = 0
+    # Fan partition policy: 'split' = contiguous per-device macro-ranges
+    # (fleet-idiom shards, per-device scan clocks); 'interleave' = one
+    # frontier dealt round-robin per launch window (mesh-gang coverage order).
+    device_shard: str = "split"
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
     pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
     step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
@@ -62,6 +71,15 @@ class ClientConfig:
     def __post_init__(self):
         if self.run_steps < 0:
             raise ValueError("--run_steps must be >= 0 (0 = auto)")
+        if self.devices < -1:
+            raise ValueError("--devices must be >= -1 (-1 = all local devices)")
+        if self.devices and self.mesh_devices:
+            raise ValueError(
+                "--devices (pmap fan) and --mesh_devices (shard_map gang) "
+                "are mutually exclusive"
+            )
+        if self.device_shard not in ("split", "interleave"):
+            raise ValueError("--device_shard must be 'split' or 'interleave'")
         if self.pipeline < 0:
             raise ValueError("--pipeline must be >= 0 (0 = auto)")
         if self.shared_steps_cap < 0:
@@ -119,9 +137,21 @@ def parse_args(argv=None) -> ClientConfig:
                    help="external work server (backend=subprocess)")
     p.add_argument("--max_batch", type=int, default=c.max_batch)
     p.add_argument("--mesh_devices", type=int, default=c.mesh_devices,
-                   help="gang N local devices onto every hash; 0 = plain "
-                   "single-device path (backend=jax; the multi-chip "
-                   "latency mode)")
+                   help="gang N local devices onto every hash via the "
+                   "shard_map mesh; 0 = off (backend=jax; needs jax >= 0.6 "
+                   "— on older jax use --devices, the shard_map-free fan)")
+    p.add_argument("--devices", type=int, default=c.devices,
+                   help="fan every work item's nonce shard across N local "
+                   "devices via pmap — the shard_map-free multi-chip path "
+                   "(backend=jax; 0 = single device, -1 = all local "
+                   "devices; mutually exclusive with --mesh_devices)")
+    p.add_argument("--device_shard", default=c.device_shard,
+                   choices=["split", "interleave"],
+                   help="fan partition policy: 'split' gives each device a "
+                   "contiguous macro-range of the work item's nonce shard "
+                   "(per-device scan clocks and EMA attribution); "
+                   "'interleave' deals each launch's consecutive windows "
+                   "round-robin across devices")
     p.add_argument("--run_steps", type=int, default=c.run_steps,
                    help="max windows per device launch (backend=jax; 0 = "
                    "auto: device-resident runs on TPU, single windows "

@@ -1,6 +1,20 @@
 """Multi-chip parallelism: device meshes, sharded nonce search, ICI winner
-election. See mesh_search for the design rationale."""
+election. See mesh_search for the design rationale.
 
+Two gang implementations share one contract: the shard_map mesh
+(mesh_search, jax >= 0.6 — ``has_shard_map`` gates it) and the pmap fan
+(fan_search — runs on every jax this project supports, including this
+image's 0.4.37). Engines pick the fan by default and keep the mesh as the
+capability-gated fast path."""
+
+from .fan_search import (  # noqa: F401
+    FAN_AXIS,
+    fan_devices,
+    fan_search_chunk_batch,
+    fan_search_devices,
+    fan_search_run,
+    has_shard_map,
+)
 from .mesh_search import (  # noqa: F401
     BATCH_AXIS,
     NONCE_AXIS,
