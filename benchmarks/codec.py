@@ -1,0 +1,140 @@
+"""Wire-codec micro-bench: encode/decode ns/msg, ASCII v0 vs binary v1.
+
+The transport hot path renders and parses one payload per work item per
+lane per tick (ROADMAP item 5); this bench prices exactly that marginal
+cost for both wire generations at batch widths 1 / 8 / 64. The v0 column
+is per-message by construction (the ASCII grammar has no batch form — a
+64-item flush is 64 encodes and 64 parses); the v1 column divides one
+frame's encode/decode by its item count, which is how the coordinator and
+the client's unbatching work handler actually amortize it.
+
+Payload shape is the fleet hot-path worst case: hash + difficulty + trace
+id + nonce range (every optional field present). Pure host measurement —
+no jax, no transport; min-of-rounds against scheduler noise.
+
+Usage: python benchmarks/codec.py [--frames 2000] [--rounds 5] [--json]
+
+The ISSUE 7 acceptance floor (binary v1 decode >= 5x v0 at batch 64) is
+asserted in-process unless --no-assert; BENCH_r07.json records a capture.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import json
+import time
+
+from tpu_dpow.transport import mqtt_codec as mc
+from tpu_dpow.transport import wire
+
+TRACE = "0123456789abcdef"
+BATCHES = (1, 8, 64)
+
+
+def _items(n: int):
+    return [
+        (
+            f"{i:064X}",
+            0xFFFFFFC000000000 + i,
+            TRACE,
+            (i * 0x1000, 0x4000000000000000),
+        )
+        for i in range(n)
+    ]
+
+
+def _time_per_msg(fn, frames: int, batch: int, rounds: int) -> float:
+    """ns per MESSAGE (not per call): min over rounds of wall / (frames *
+    batch). fn runs one frame's worth of work."""
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(frames):
+            fn()
+        dt = time.perf_counter() - t0
+        best = min(best, dt / (frames * batch) * 1e9)
+    return best
+
+
+def bench(frames: int, rounds: int) -> dict:
+    out = {}
+    for batch in BATCHES:
+        items = _items(batch)
+        v0_payloads = [mc.encode_work_payload(*it) for it in items]
+        v1_frame = wire.encode_work_items(items)
+
+        def v0_encode():
+            for it in items:
+                mc.encode_work_payload(*it)
+
+        def v0_decode():
+            # decode-to-usable-fields: the ASCII parser yields a hex
+            # difficulty the consumer must still int() — that conversion
+            # is part of the v0 path's real cost (client/app.py)
+            for p in v0_payloads:
+                int(mc.parse_work_payload(p)[1], 16)
+
+        def v1_encode():
+            wire.encode_work_items(items)
+
+        def v1_decode():
+            wire.decode_work_frame(v1_frame)
+
+        # warmup outside timing
+        v0_encode(), v0_decode(), v1_encode(), v1_decode()
+        row = {
+            "v0_encode_ns": round(_time_per_msg(v0_encode, frames, batch, rounds), 1),
+            "v0_decode_ns": round(_time_per_msg(v0_decode, frames, batch, rounds), 1),
+            "v1_encode_ns": round(_time_per_msg(v1_encode, frames, batch, rounds), 1),
+            "v1_decode_ns": round(_time_per_msg(v1_decode, frames, batch, rounds), 1),
+            "v0_bytes_per_msg": sum(len(p) for p in v0_payloads) / batch,
+            "v1_bytes_per_msg": round(len(v1_frame) / batch, 1),
+        }
+        row["decode_speedup"] = round(row["v0_decode_ns"] / row["v1_decode_ns"], 2)
+        row["encode_speedup"] = round(row["v0_encode_ns"] / row["v1_encode_ns"], 2)
+        out[f"batch_{batch}"] = row
+
+    # the result path (single message; the server parses one per worker win)
+    res_v0 = mc.encode_result_payload("AB" * 32, "3108a2891093ce9e", "nano_" + "1" * 60, TRACE)
+    res_v1 = wire.encode_result("AB" * 32, "3108a2891093ce9e", "nano_" + "1" * 60, TRACE)
+    out["result"] = {
+        "v0_decode_ns": round(
+            _time_per_msg(lambda: mc.parse_result_payload(res_v0), frames, 1, rounds), 1
+        ),
+        "v1_decode_ns": round(
+            _time_per_msg(lambda: wire.decode_result_frame(res_v1), frames, 1, rounds), 1
+        ),
+    }
+    out["result"]["decode_speedup"] = round(
+        out["result"]["v0_decode_ns"] / out["result"]["v1_decode_ns"], 2
+    )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--frames", type=int, default=2000, help="frames per round")
+    ap.add_argument("--rounds", type=int, default=5, help="min-of rounds")
+    ap.add_argument("--no-assert", action="store_true",
+                    help="skip the >=5x batch-64 decode floor assertion")
+    args = ap.parse_args()
+    result = {
+        "bench": "codec_ns_per_msg",
+        "frames": args.frames,
+        "rounds": args.rounds,
+        "payload_shape": "hash+difficulty+trace+range (all fields present)",
+        **bench(args.frames, args.rounds),
+    }
+    print(json.dumps(result, indent=1))
+    if not args.no_assert:
+        speedup = result["batch_64"]["decode_speedup"]
+        assert speedup >= 5.0, (
+            f"acceptance floor: v1 decode must be >=5x v0 at batch 64, got "
+            f"{speedup}x"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,12 @@ FLEET_TESTS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py --collect-only -q -p no:cacheprovider \
     2>/dev/null | grep -c '::' || true)
 echo "FLEET_TESTS=${FLEET_TESTS}"
+# Wire-codec coverage at a glance (ISSUE 7): how many tier-1 tests pin the
+# codec goldens / interop / coalescing contracts. Collection only.
+CODEC_GOLDENS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_wire.py --collect-only -q -p no:cacheprovider \
+    2>/dev/null | grep -c '::' || true)
+echo "CODEC_GOLDENS=${CODEC_GOLDENS}"
 # dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
 # or how many findings escaped the baseline (docs/analysis.md).
 DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)

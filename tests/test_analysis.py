@@ -491,6 +491,109 @@ def test_topic_contract_normalizes_fstring_lanes(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DPOW605/606 payload-grammar (binary frame table)
+# ---------------------------------------------------------------------------
+
+_WIRE = (
+    "FRAME_GRAMMAR = {\n"
+    '    "work": (0x11, "hash:32 difficulty:u64"),\n'
+    '    "result": (0x13, "hash:32 nonce:u64"),\n'
+    "}\n"
+)
+
+_FRAME_SPEC = (
+    "# Spec\n\n## Payload grammar\n\n"
+    "| Kind | Header byte | Body layout |\n"
+    "|------|-------------|-------------|\n"
+    "| `work` | `0x11` | `hash:32 difficulty:u64` |\n"
+    "| `result` | `0x13` | `hash:32 nonce:u64` |\n"
+)
+
+
+def _frame_project(tmp_path, wire_src=_WIRE, spec=_FRAME_SPEC):
+    return make_project(
+        tmp_path,
+        {
+            "tpu_dpow/transport/wire.py": wire_src,
+            "docs/specification.md": spec,
+        },
+    )
+
+
+def test_frame_grammar_clean_when_code_and_spec_agree(tmp_path):
+    assert topics.check(_frame_project(tmp_path)) == []
+
+
+def test_frame_grammar_fires_on_undocumented_code_kind(tmp_path):
+    wire_src = _WIRE.replace(
+        "}\n", '    "work_batch": (0x12, "count:u8 work-item{count}"),\n}\n'
+    )
+    found = topics.check(_frame_project(tmp_path, wire_src=wire_src))
+    assert codes(found) == ["DPOW605"]
+    assert "work_batch" in found[0].message
+
+
+def test_frame_grammar_fires_on_drifted_byte_or_layout(tmp_path):
+    drift_byte = _FRAME_SPEC.replace("`0x11`", "`0x14`")
+    found = topics.check(_frame_project(tmp_path, spec=drift_byte))
+    assert codes(found) == ["DPOW605"]
+    drift_layout = _FRAME_SPEC.replace(
+        "| `work` | `0x11` | `hash:32 difficulty:u64` |",
+        "| `work` | `0x11` | `hash:32 difficulty:u32` |",
+    )
+    found = topics.check(_frame_project(tmp_path, spec=drift_layout))
+    assert codes(found) == ["DPOW605"]
+    assert "drifted" in found[0].message
+
+
+def test_frame_grammar_fires_on_spec_row_without_code(tmp_path):
+    spec = _FRAME_SPEC + "| `cancel` | `0x14` | `hash:32` |\n"
+    found = topics.check(_frame_project(tmp_path, spec=spec))
+    assert codes(found) == ["DPOW606"]
+    assert "cancel" in found[0].message
+
+
+def test_frame_grammar_skipped_when_wire_module_absent(tmp_path):
+    # pre-v1 trees / fixtures without the codec must not fire
+    project = make_project(
+        tmp_path, {"docs/specification.md": _FRAME_SPEC}
+    )
+    assert topics.check(project) == []
+
+
+def test_frame_grammar_whole_repo_delete_any_row_fires(tmp_path):
+    """The delete-one-row property against the REAL repo: removing any
+    row of the spec's binary-frame table must surface DPOW605."""
+    docs_copy = tmp_path / "docs"
+    docs_copy.mkdir()
+    for f in (REPO_ROOT / "docs").glob("*.md"):
+        docs_copy.joinpath(f.name).write_text(
+            f.read_text(encoding="utf-8"), encoding="utf-8"
+        )
+    spec_md = docs_copy / "specification.md"
+    pristine = spec_md.read_text(encoding="utf-8")
+    lines = pristine.splitlines()
+    victims = [
+        i for i, row in enumerate(lines)
+        if row.startswith("|") and "| `0x" in row
+    ]
+    assert len(victims) == 3, "spec lost its binary-frame rows?"
+    project = Project(REPO_ROOT, docs_dir=str(docs_copy))
+    assert [f for f in topics.check(project) if f.code.startswith("DPOW60")
+            and f.code in ("DPOW605", "DPOW606")] == []
+    for victim in victims:
+        kind = lines[victim].split("`")[1]
+        spec_md.write_text(
+            "\n".join(lines[:victim] + lines[victim + 1:]), encoding="utf-8"
+        )
+        found = topics.check(project)
+        assert any(
+            f.code == "DPOW605" and f"'{kind}'" in f.message for f in found
+        ), f"deleting the {kind} frame row must surface DPOW605"
+    spec_md.write_text(pristine, encoding="utf-8")
+
+
+# ---------------------------------------------------------------------------
 # DPOW701-703 flag-drift
 # ---------------------------------------------------------------------------
 

@@ -45,11 +45,12 @@ from tpu_dpow.client import ClientConfig, DpowClient
 from tpu_dpow.models import WorkRequest
 from tpu_dpow.resilience import OPEN, FailoverBackend
 from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.server.exceptions import RetryRequest
 from tpu_dpow.store import MemoryStore
 from tpu_dpow.transport import Message, TransportError
 from tpu_dpow.transport.broker import Broker
 from tpu_dpow.transport.inproc import InProcTransport
-from tpu_dpow.transport.mqtt_codec import parse_work_payload
+from tpu_dpow.transport.mqtt_codec import encode_result_payload, parse_work_payload
 from tpu_dpow.utils import nanocrypto as nc
 
 pytestmark = pytest.mark.chaos
@@ -628,6 +629,83 @@ def test_chaos_overload_burst_bounded_window_shed_order_and_recovery():
                 worker_task.cancel()
                 await asyncio.gather(worker_task, return_exceptions=True)
             await worker_transport.close()
+            await server.close()
+
+    run(main())
+
+
+def test_chaos_coalesced_waiters_winner_races_one_cancel():
+    """ISSUE 7 chaos scenario: three same-hash on-demand requests coalesce
+    onto ONE dispatch (sum(dpow_coalesce_total) == 2); the winning result
+    then races one waiter's cancellation. Whatever the interleaving, the
+    two surviving waiters get the work, the raced waiter either serves
+    from the store or aborts cleanly — and the LAST waiter out tears the
+    whole dispatch down (futures, gates, tickets, supervisor)."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        config = ServerConfig(
+            base_difficulty=EASY, throttle=1000.0, heartbeat_interval=3600.0,
+            statistics_interval=3600.0, work_republish_interval=2.0,
+            fleet=False,
+        )
+        store = MemoryStore()
+        server = DpowServer(
+            config, store, InProcTransport(broker, client_id="server"),
+            clock=clock,
+        )
+        await server.setup()
+        server.start_loops()
+        await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                         "public": "N", "precache": "0",
+                                         "ondemand": "0"})
+        await store.sadd("services", "svc")
+        try:
+            h = random_hash()
+            reqs = [
+                asyncio.ensure_future(server.service_handler(
+                    {"user": "svc", "api_key": "secret", "hash": h,
+                     "timeout": 25}
+                ))
+                for _ in range(3)
+            ]
+            await settle()
+            # one dispatch, three coalesced-or-dispatching waiters
+            assert len(server.work_futures) == 1
+            assert server._future_waiters.get(h) == 3
+            assert sum(server._m_coalesce.collect().values()) == 2
+
+            # RACE: cancel one waiter and land the winner in the same
+            # event-loop turn — no settle between the two.
+            work = solve(h, EASY)
+            reqs[0].cancel()
+            await server.client_result_handler(
+                "result/ondemand",
+                encode_result_payload(h, work, PAYOUT_1),
+            )
+            results = await asyncio.gather(*reqs, return_exceptions=True)
+
+            # the two un-raced waiters MUST be served
+            for r in results[1:]:
+                assert r == {"work": work, "hash": h}, r
+            # the raced waiter either caught the landed result on its way
+            # out or aborted cleanly — never hung, never a stray error
+            assert (
+                results[0] == {"work": work, "hash": h}
+                or isinstance(results[0], (asyncio.CancelledError, RetryRequest))
+            ), results[0]
+
+            await settle()
+            # last-waiter teardown: every per-dispatch side table is gone
+            assert server.work_futures == {}
+            assert server._future_waiters == {}
+            assert server._dispatch_gates == {}
+            assert server._dispatch_tickets == {}
+            assert server._difficulty_locks == {}
+            assert not server.supervisor.tracked(h)
+        finally:
             await server.close()
 
     run(main())

@@ -280,10 +280,13 @@ def test_sqlite_incrby_preserves_ttl(tmp_path):
 
         s = _sqlite(tmp_path)
         await s.setup()
-        await s.set("counter", "1", expire=0.08)
+        # Generous TTL margin: sqlite round trips on a loaded 2-core
+        # gVisor box have been observed taking >80 ms, which expired the
+        # old 0.08 s TTL before the incrby/get below ever ran (flake).
+        await s.set("counter", "1", expire=0.5)
         assert await s.incrby("counter", 2) == 3
         assert await s.get("counter") == "3"
-        _time.sleep(0.1)
+        _time.sleep(0.6)
         assert await s.get("counter") is None  # TTL survived the incrby
         await s.close()
 

@@ -1,0 +1,782 @@
+"""Binary wire codec v1 + batched frames + same-hash coalescing (ISSUE 7).
+
+Four contracts pinned here:
+
+  * LEGACY BYTE GOLDENS — every v0 ASCII payload shape (work plain / trace
+    / range / both, trace-token order freedom, result plain / trace) stays
+    byte-identical: the compatibility appendix of docs/specification.md is
+    normative and a v0-only peer must keep parsing us unchanged.
+  * v1 frame grammar — roundtrips for every flag combination, batch
+    frames, first-byte version detection (disjoint by construction from
+    every legacy first byte), malformed-frame rejection, and lossless
+    transit through the str-typed transports (JSON-lines + UTF-8).
+  * NEGOTIATION — the fleet coordinator speaks v1 only to workers that
+    announced the capability (downgrade counter otherwise), the client
+    unbatches WORK_BATCH frames into the engine API and replies in the
+    codec the dispatch spoke; mixed old/new fleets solve real work through
+    the inproc broker in all three pairings (v1/v1, v0 client vs v1
+    server, v1 client vs v0 server).
+  * COALESCING — K concurrent same-hash on-demand requests produce exactly
+    one backend dispatch and K served waiters, sum(dpow_coalesce_total)
+    == K-1, per-service quota charged for all K; --no_coalesce restores
+    the independent-admission path.
+"""
+
+import asyncio
+import hashlib
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from tpu_dpow import obs
+from tpu_dpow.backend import WorkBackend, WorkCancelled
+from tpu_dpow.chaos import FakeClock, join_client
+from tpu_dpow.client import ClientConfig, DpowClient
+from tpu_dpow.fleet import CoverageTracker, FleetCoordinator, FleetPlanner, WorkerRegistry
+from tpu_dpow.models import WorkRequest, WorkType
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import Message, mqtt_codec as mc, wire
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.utils import nanocrypto as nc
+
+RNG = np.random.default_rng(0x77)
+EASY = 0xFF00000000000000  # ~256 expected hashes: instant to brute-force
+PAYOUTS = [nc.encode_account(bytes(range(i, i + 32))) for i in range(5)]
+TID = "00deadbeef00cafe"
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def settle(seconds=0.05):
+    await asyncio.sleep(seconds)
+
+
+def solve_from(block_hash: str, difficulty: int, start: int = 0) -> str:
+    h = bytes.fromhex(block_hash)
+    w = start
+    while True:
+        v = int.from_bytes(
+            hashlib.blake2b(struct.pack("<Q", w & nc.MAX_U64) + h,
+                            digest_size=8).digest(),
+            "little",
+        )
+        if v >= difficulty:
+            return f"{w & nc.MAX_U64:016x}"
+        w += 1
+
+
+# ------------------------------------------------- legacy v0 byte goldens
+
+
+def test_v0_work_payload_byte_goldens_all_shapes():
+    h = "AB" * 32
+    rng = (0x123456789ABCDEF0, 0x4000000000000000)
+    assert mc.encode_work_payload(h, 0xFFFFFFC000000000) == (
+        f"{h},ffffffc000000000")
+    assert mc.encode_work_payload(h, 0xFFFFFFC000000000, TID) == (
+        f"{h},ffffffc000000000,{TID}")
+    assert mc.encode_work_payload(h, 0xFFFFFFC000000000, None, rng) == (
+        f"{h},ffffffc000000000,123456789abcdef0+4000000000000000")
+    assert mc.encode_work_payload(h, 0xFFFFFFC000000000, TID, rng) == (
+        f"{h},ffffffc000000000,{TID},123456789abcdef0+4000000000000000")
+    # trailing-token order freedom is part of the golden contract
+    swapped = f"{h},ffffffc000000000,123456789abcdef0+4000000000000000,{TID}"
+    assert mc.parse_work_payload(swapped) == (h, "ffffffc000000000", TID, rng)
+
+
+def test_v0_result_payload_byte_goldens():
+    h = "CD" * 32
+    assert mc.encode_result_payload(h, "3108a2891093ce9e", PAYOUTS[0]) == (
+        f"{h},3108a2891093ce9e,{PAYOUTS[0]}")
+    assert mc.encode_result_payload(h, "3108a2891093ce9e", PAYOUTS[0], TID) == (
+        f"{h},3108a2891093ce9e,{PAYOUTS[0]},{TID}")
+    assert mc.parse_result_payload(f"{h},abcd,client") == (h, "abcd", "client", None)
+
+
+def test_every_v0_first_byte_is_detected_as_v0():
+    # the entire legal legacy first-byte alphabet: hex digits + comma
+    for c in "0123456789abcdefABCDEF,":
+        assert wire.wire_version(c + "rest") == wire.V0
+    assert wire.wire_version("") == wire.V0
+
+
+# --------------------------------------------------------- v1 frame codec
+
+
+def test_v1_work_single_roundtrip_all_flag_combos():
+    h = random_hash()
+    for trace in (None, TID):
+        for rng in (None, (5, 1000), (0, 0), ((1 << 64) - 1, (1 << 64) - 1)):
+            frame = wire.encode_work_items([(h, EASY, trace, rng)])
+            assert wire.wire_version(frame) == wire.V1
+            assert ord(frame[0]) == wire.KIND_WORK
+            # v1 decodes to NATIVE types: lowercase hex hash (WorkRequest
+            # canonicalizes) and an int difficulty (no hex round-trip)
+            assert wire.decode_work_frame(frame) == [
+                (h.lower(), EASY, trace, rng)
+            ]
+            # the any-router returns the same items
+            assert wire.decode_work_any(frame) == [(h.lower(), EASY, trace, rng)]
+
+
+def test_v1_work_accepts_difficulty_as_hex_string_too():
+    h = random_hash()
+    a = wire.encode_work_items([(h, EASY, None, None)])
+    b = wire.encode_work_items([(h, f"{EASY:016x}", None, None)])
+    assert a == b
+
+
+def test_v1_work_batch_roundtrip_and_limits():
+    items = [
+        (random_hash(), EASY, TID if i % 2 else None,
+         (i * 1000, 500) if i % 3 else None)
+        for i in range(64)
+    ]
+    frame = wire.encode_work_items(items)
+    assert ord(frame[0]) == wire.KIND_WORK_BATCH
+    decoded = wire.decode_work_frame(frame)
+    assert decoded == [
+        (h.lower(), d, t, r) for h, d, t, r in items
+    ]
+    # a batch is one frame: v0 would be 64 separate publishes
+    with pytest.raises(ValueError):
+        wire.encode_work_items([])
+    with pytest.raises(ValueError):
+        wire.encode_work_items([items[0]] * 256)
+
+
+def test_v1_uniform_batches_use_the_fast_path_equivalently():
+    """Uniform-flag batches decode via a C-level record-array pass; the
+    result must be indistinguishable from the general loop (mixed-flag
+    frames, which always take it)."""
+    for shape in (
+        lambda i: (random_hash(), EASY, TID, (i * 10, 5)),  # flags 3
+        lambda i: (random_hash(), EASY, None, None),        # flags 0
+    ):
+        items = [shape(i) for i in range(32)]
+        decoded = wire.decode_work_frame(wire.encode_work_items(items))
+        assert decoded == [(h.lower(), d, t, r) for h, d, t, r in items]
+        # per-item frames give the same items as the batch
+        singles = [
+            wire.decode_work_frame(wire.encode_work_items([it]))[0]
+            for it in items
+        ]
+        assert singles == decoded
+
+
+def test_v1_result_roundtrip():
+    h = random_hash()
+    for trace in (None, TID):
+        frame = wire.encode_result(h, "00000000000004d2", PAYOUTS[1], trace)
+        assert wire.wire_version(frame) == wire.V1
+        assert wire.decode_result_frame(frame) == (
+            h, "00000000000004d2", PAYOUTS[1], trace
+        )
+        assert wire.decode_result_any(frame) == (
+            h, "00000000000004d2", PAYOUTS[1], trace
+        )
+
+
+def test_v1_malformed_frames_raise_valueerror():
+    h = random_hash()
+    good = wire.encode_work_items([(h, EASY, TID, (1, 2))])
+    for bad in (
+        good[:-1],                       # truncated optional field
+        good + "\x00",                   # trailing bytes
+        chr(wire.KIND_WORK_BATCH),       # batch with no count
+        chr(wire.KIND_WORK_BATCH) + "\x00",  # zero-count batch
+        chr(0x1F) + good[1:],            # unknown kind in the v1 range
+    ):
+        with pytest.raises(ValueError):
+            wire.decode_work_frame(bad)
+    r = wire.encode_result(h, "00000000000004d2", PAYOUTS[1], TID)
+    for bad in (r[:-1], r + "\x00", r[:40]):
+        with pytest.raises(ValueError):
+            wire.decode_result_frame(bad)
+    # work frames are not result frames and vice versa
+    with pytest.raises(ValueError):
+        wire.decode_result_frame(good)
+    with pytest.raises(ValueError):
+        wire.decode_work_frame(r)
+    # encode guards: malformed fields fail loudly (senders fall back to v0)
+    with pytest.raises(ValueError):
+        wire.encode_work_items([("AB", EASY, None, None)])  # short hash
+    with pytest.raises(ValueError):
+        wire.encode_work_items([(h, EASY, "nothex!", None)])
+    with pytest.raises(ValueError):
+        wire.encode_result(h, "xyz", PAYOUTS[0])
+    with pytest.raises(ValueError):
+        wire.encode_result(h, "00000000000004d2", "x" * 300)
+
+
+def test_v1_frames_survive_the_str_transports_losslessly():
+    """The TCP face ships payloads through json.dumps and the MQTT face
+    through UTF-8 encode/decode — both must round-trip a latin-1 byte
+    string exactly."""
+    h = random_hash()
+    frame = wire.encode_work_items(
+        [(h, EASY, TID, (0x0102030405060708, 0xF0E0D0C0B0A09080))]
+    )
+    assert json.loads(json.dumps({"payload": frame}))["payload"] == frame
+    assert frame.encode("utf-8").decode("utf-8") == frame
+    assert wire.decode_work_frame(
+        json.loads(json.dumps({"p": frame}))["p"]
+    ) == wire.decode_work_frame(frame)
+
+
+def test_v1_frames_are_smaller_than_v0():
+    h = random_hash()
+    v0 = mc.encode_work_payload(h, EASY, TID, (5, 1000))
+    v1 = wire.encode_work_items([(h, EASY, TID, (5, 1000))])
+    assert len(v1) < len(v0)
+    batch = wire.encode_work_items([(h, EASY, TID, (5, 1000))] * 8)
+    assert len(batch) < 8 * len(v0)
+
+
+# ------------------------------------------- coordinator codec negotiation
+
+
+class RecordingTransport:
+    connected = True
+
+    def __init__(self):
+        self.published = []
+
+    async def connect(self):
+        pass
+
+    async def publish(self, topic, payload, qos=0):
+        self.published.append((topic, payload))
+
+    async def subscribe(self, pattern, qos=0):
+        pass
+
+    async def messages(self):
+        return
+        yield  # pragma: no cover
+
+    async def close(self):
+        pass
+
+    def lane(self, worker_id):
+        return [p for t, p in self.published if t.endswith(f"/{worker_id}")]
+
+
+def _announce(worker_id, hashrate=1e6, codec=None):
+    data = {"v": 1, "id": worker_id, "backend": "jax", "concurrency": 8,
+            "hashrate": hashrate, "work": ["precache", "ondemand"]}
+    if codec is not None:
+        data["codec"] = codec
+    return json.dumps(data)
+
+
+def _coordinator(transport, clock, store, codec_v1=True, min_workers=2):
+    reg = WorkerRegistry(store, clock=clock, ttl=45.0)
+    coord = FleetCoordinator(
+        reg,
+        FleetPlanner(reg, min_workers=min_workers),
+        CoverageTracker(reg),
+        transport,
+        clock=clock,
+        codec_v1=codec_v1,
+    )
+    return reg, coord
+
+
+def test_coordinator_speaks_v1_only_to_advertising_workers():
+    async def main():
+        obs.reset()
+        clock, store, t = FakeClock(), MemoryStore(), RecordingTransport()
+        reg, coord = _coordinator(t, clock, store)
+        await reg.handle_announce(_announce("w1", codec=1))
+        await reg.handle_announce(_announce("w2"))  # legacy: no capability
+        h = random_hash()
+        mode = await coord.publish_work(h, EASY, "ondemand", TID)
+        assert mode == "sharded"
+        (v1_payload,) = t.lane("w1")
+        (v0_payload,) = t.lane("w2")
+        assert wire.wire_version(v1_payload) == wire.V1
+        items = wire.decode_work_frame(v1_payload)
+        assert items[0][0].upper() == h and items[0][2] == TID
+        assert wire.wire_version(v0_payload) == wire.V0
+        assert mc.parse_work_payload(v0_payload)[0] == h
+        # the v0 lane counted one downgrade; both encodes were counted
+        assert wire.M_DOWNGRADE.value() == 1
+        frames = wire.M_FRAMES
+        assert frames.value("encode", "v1", "work") == 1
+        assert frames.value("encode", "v0", "work") == 1
+
+    run(main())
+
+
+def test_coordinator_codec_v0_policy_pins_everything_ascii():
+    async def main():
+        obs.reset()
+        clock, store, t = FakeClock(), MemoryStore(), RecordingTransport()
+        reg, coord = _coordinator(t, clock, store, codec_v1=False)
+        await reg.handle_announce(_announce("w1", codec=1))
+        await reg.handle_announce(_announce("w2", codec=1))
+        await coord.publish_work(random_hash(), EASY, "ondemand")
+        assert t.published
+        for _, payload in t.published:
+            assert wire.wire_version(payload) == wire.V0
+        # a policy downgrade is not a PEER downgrade: nothing counted
+        assert wire.M_DOWNGRADE.value() == 0
+
+    run(main())
+
+
+def test_coordinator_lane_batches_multiple_items_into_one_frame():
+    async def main():
+        obs.reset()
+        clock, store, t = FakeClock(), MemoryStore(), RecordingTransport()
+        reg, coord = _coordinator(t, clock, store)
+        await reg.handle_announce(_announce("w1", codec=1))
+        h = random_hash()
+        await coord._publish_lane(
+            "ondemand", "w1",
+            [(h, EASY, TID, (0, 100)), (h, EASY, TID, (100, 200))],
+        )
+        (payload,) = t.lane("w1")  # ONE publish for two shards
+        items = wire.decode_work_frame(payload)
+        assert [i[3] for i in items] == [(0, 100), (100, 200)]
+        assert wire.M_FRAMES.value("encode", "v1", "work_batch") == 1
+        occ = wire.M_BATCH.collect()
+        assert list(occ.values())[0]["count"] == 1
+
+    run(main())
+
+
+def test_coordinator_falls_back_to_v0_when_v1_encode_fails():
+    async def main():
+        obs.reset()
+        clock, store, t = FakeClock(), MemoryStore(), RecordingTransport()
+        reg, coord = _coordinator(t, clock, store)
+        await reg.handle_announce(_announce("w1", codec=1))
+        # a short (non-64-hex) hash cannot ride v1; the dispatch must still
+        # go out as ASCII rather than vanish
+        await coord._publish_lane("ondemand", "w1", [("AB", EASY, None, (1, 2))])
+        (payload,) = t.lane("w1")
+        assert wire.wire_version(payload) == wire.V0
+        assert mc.parse_work_payload(payload)[0] == "AB"
+
+    run(main())
+
+
+def test_republish_recover_bookkeeping_waits_for_the_lane_publish():
+    """A transport failure during the deferred lane flush must NOT leave
+    the cover table claiming the replacement worker owns the shard (or the
+    recovered counter incremented): bookkeeping follows the wire."""
+
+    async def main():
+        obs.reset()
+        clock, store = FakeClock(), MemoryStore()
+        t = RecordingTransport()
+        reg, coord = _coordinator(t, clock, store)
+        await reg.handle_announce(_announce("w1"))
+        await reg.handle_announce(_announce("w2"))
+        h = random_hash()
+        assert await coord.publish_work(h, EASY, "ondemand") == "sharded"
+        owners_before = coord.cover.current_owners(h)
+
+        # w1 dies; its shard must be re-covered onto w2 at the next heal
+        reg._workers["w1"].last_seen = clock.time() - 100.0
+        await clock.advance(5.0)
+
+        real_publish = t.publish
+
+        async def failing_publish(topic, payload, qos=0):
+            if topic.startswith("work/ondemand/"):
+                raise OSError("broker reconnecting")
+            return await real_publish(topic, payload, qos=qos)
+
+        t.publish = failing_publish
+        recovered = obs.get_registry().counter(
+            "dpow_fleet_ranges_recovered_total")
+        with pytest.raises(OSError):
+            await coord.republish(h, EASY, "ondemand", hedged=False)
+        # nothing recorded: the shard is still orphaned, the next heal
+        # (with the transport back) re-covers it for real
+        assert recovered.value() == 0
+        assert coord.cover.current_owners(h) == owners_before
+        t.publish = real_publish
+        assert await coord.republish(h, EASY, "ondemand", hedged=False)
+        assert recovered.value() == 1
+        assert "w2" in coord.cover.current_owners(h)
+
+    run(main())
+
+
+# --------------------------------------------- client unbatch + reply codec
+
+
+class ScriptedBackend(WorkBackend):
+    def __init__(self):
+        self.requests = {}
+        self.futures = {}
+        self.covered = {}
+
+    async def setup(self):
+        pass
+
+    async def generate(self, request):
+        self.requests[request.block_hash] = request
+        fut = asyncio.get_running_loop().create_future()
+        self.futures[request.block_hash] = fut
+        return await fut
+
+    async def cancel(self, block_hash):
+        fut = self.futures.get(block_hash)
+        if fut and not fut.done():
+            fut.set_exception(WorkCancelled(block_hash))
+
+    async def cover_range(self, block_hash, nonce_range):
+        if block_hash not in self.futures or self.futures[block_hash].done():
+            return False
+        self.covered[block_hash] = nonce_range
+        return True
+
+    def solve(self, block_hash, work):
+        fut = self.futures.get(block_hash)
+        if fut and not fut.done():
+            fut.set_result(work)
+
+
+def _bare_client(codec="v1"):
+    t = RecordingTransport()
+    client = DpowClient(
+        ClientConfig(payout_address=PAYOUTS[0], codec=codec),
+        t,
+        backend=ScriptedBackend(),
+    )
+    return client, t
+
+
+def test_client_unbatches_work_batch_into_queue():
+    async def main():
+        client, _ = _bare_client()
+        h1, h2 = random_hash(), random_hash()
+        frame = wire.encode_work_items(
+            [(h1, EASY, None, (0, 100)), (h2, EASY, None, None)]
+        )
+        await client.handle_work("ondemand", frame)
+        assert h1 in client.work_handler.queue
+        assert h2 in client.work_handler.queue
+        assert client.work_handler.queue.get(h1).nonce_range == (0, 100)
+        assert client.work_handler.queue.get(h2).nonce_range is None
+
+    run(main())
+
+
+def test_client_replies_in_the_codec_the_dispatch_spoke():
+    async def main():
+        client, t = _bare_client()
+        v1_hash, v0_hash = random_hash(), random_hash()
+        await client.handle_work(
+            "ondemand", wire.encode_work_items([(v1_hash, EASY, None, None)])
+        )
+        await client.handle_work(
+            "ondemand", mc.encode_work_payload(v0_hash, EASY)
+        )
+        for h in (v1_hash, v0_hash):
+            await client._send_result(
+                WorkRequest(block_hash=h, difficulty=EASY,
+                            work_type=WorkType.ONDEMAND),
+                "00000000000004d2",
+            )
+        p_v1 = next(p for t_, p in t.published if t_.startswith("result/")
+                    and wire.wire_version(p) == wire.V1)
+        assert wire.decode_result_frame(p_v1)[0] == v1_hash
+        p_v0 = next(p for t_, p in t.published if t_.startswith("result/")
+                    and wire.wire_version(p) == wire.V0)
+        assert mc.parse_result_payload(p_v0)[0] == v0_hash
+        # the reply-in-kind marker is consumed: a SECOND result for the
+        # same hash (shouldn't happen, but) would fall back to v0
+        assert v1_hash not in client._v1_dispatched
+
+    run(main())
+
+
+def test_client_codec_v0_never_replies_binary():
+    async def main():
+        client, t = _bare_client(codec="v0")
+        h = random_hash()
+        # even for work that ARRIVED v1 (reception has no flag)
+        await client.handle_work(
+            "ondemand", wire.encode_work_items([(h, EASY, None, None)])
+        )
+        assert h in client.work_handler.queue
+        # no dead reply-in-kind state: _send_result can never consume it
+        assert h not in client._v1_dispatched
+        await client._send_result(
+            WorkRequest(block_hash=h, difficulty=EASY,
+                        work_type=WorkType.ONDEMAND),
+            "00000000000004d2",
+        )
+        (payload,) = [p for t_, p in t.published if t_.startswith("result/")]
+        assert wire.wire_version(payload) == wire.V0
+
+    run(main())
+
+
+# ------------------------------------------------- mixed-fleet interop e2e
+
+
+async def _stack(clock, broker, store, server_codec="v1",
+                 client_codecs=("v1",), **overrides):
+    config = ServerConfig(
+        base_difficulty=EASY, throttle=1000.0, heartbeat_interval=0.05,
+        statistics_interval=3600.0, work_republish_interval=2.0,
+        fleet_min_workers=1, codec=server_codec, **overrides,
+    )
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"), clock=clock
+    )
+    await server.setup()
+    server.start_loops()
+    await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                     "public": "N", "precache": "0",
+                                     "ondemand": "0"})
+    await store.sadd("services", "svc")
+    clients = []
+    for i, codec in enumerate(client_codecs, 1):
+        c = DpowClient(
+            ClientConfig(
+                payout_address=PAYOUTS[i % len(PAYOUTS)],
+                startup_heartbeat_wait=3.0,
+                worker_id=f"w{i}",
+                codec=codec,
+                fleet_announce_interval=3600.0,
+            ),
+            InProcTransport(broker, client_id=f"worker{i}", clean_session=False),
+            backend=ScriptedBackend(),
+        )
+        await join_client(c, server)
+        c.start_loops()
+        clients.append(c)
+    return server, clients
+
+
+async def _solve_one(server, client, *, expect_version):
+    """One on-demand request end to end; returns the served work. Asserts
+    the lane dispatch and the result reply both spoke expect_version."""
+    h = random_hash()
+    req = asyncio.ensure_future(server.service_handler(
+        {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+    ))
+    await settle()
+    backend = client.work_handler.backend
+    got = backend.requests.get(h)
+    assert got is not None, "worker never saw the dispatch"
+    if expect_version == wire.V1:
+        assert h in client._v1_dispatched  # arrived as a binary frame
+    else:
+        assert h not in client._v1_dispatched
+    start = got.nonce_range[0] if got.nonce_range else 0
+    work = solve_from(h, EASY, start)
+    backend.solve(h, work)
+    resp = await asyncio.wait_for(req, 10)
+    assert resp == {"work": work, "hash": h}
+    nc.validate_work(h, work, EASY)
+    return h, work
+
+
+@pytest.mark.parametrize(
+    "server_codec,client_codec,lane_version",
+    [
+        ("v1", "v1", wire.V1),  # both new: binary lane + binary reply
+        ("v1", "v0", wire.V0),  # legacy worker against a v1 server
+        ("v0", "v1", wire.V0),  # v1-capable worker against a legacy server
+    ],
+)
+def test_mixed_fleet_interop_solves_real_work(server_codec, client_codec,
+                                              lane_version):
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore()
+        server, clients = await _stack(
+            clock, broker, store, server_codec=server_codec,
+            client_codecs=(client_codec,),
+        )
+        try:
+            await settle()
+            assert server.fleet_registry.live_workers("ondemand")
+            await _solve_one(server, clients[0], expect_version=lane_version)
+            frames = wire.M_FRAMES
+            if lane_version == wire.V1:
+                assert frames.value("encode", "v1", "work") >= 1
+                assert frames.value("decode", "v1", "work") >= 1
+                assert frames.value("decode", "v1", "result") >= 1
+            else:
+                assert frames.value("decode", "v0", "work") >= 1
+                assert frames.value("decode", "v0", "result") >= 1
+                if server_codec == "v1":
+                    # v1 server downgraded the legacy worker's lane
+                    assert wire.M_DOWNGRADE.value() >= 1
+        finally:
+            for c in clients:
+                await c.close()
+            await server.close()
+
+    run(main())
+
+
+# ------------------------------------------------- same-hash coalescing
+
+
+async def _bare_server(clock, *, coalesce=True, quota_rate=0.0,
+                       quota_burst=20.0, **overrides):
+    store = MemoryStore()
+    t = RecordingTransport()
+    config = ServerConfig(
+        base_difficulty=EASY, throttle=1000.0, heartbeat_interval=3600.0,
+        statistics_interval=3600.0, work_republish_interval=0.0,
+        coalesce=coalesce, quota_rate=quota_rate, quota_burst=quota_burst,
+        fleet=False,
+    )
+    server = DpowServer(config, store, t, clock=clock)
+    await server.setup()
+    await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                     "public": "N", "precache": "0",
+                                     "ondemand": "0"})
+    await store.sadd("services", "svc")
+    return server, store, t
+
+
+def _work_publishes(t, h):
+    return [
+        (topic, p) for topic, p in t.published
+        if topic.startswith("work/") and h in p
+    ]
+
+
+def test_coalescing_acceptance_k_requests_one_dispatch():
+    """ISSUE 7 acceptance: K concurrent same-hash on-demand requests →
+    exactly 1 backend dispatch, K served waiters, sum(dpow_coalesce_total)
+    == K-1, and per-service quota charged for all K."""
+    K = 5
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        server, store, t = await _bare_server(
+            clock, quota_rate=0.001, quota_burst=20.0
+        )
+        h = random_hash()
+        reqs = [
+            asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+            ))
+            for _ in range(K)
+        ]
+        await settle()
+        assert len(_work_publishes(t, h)) == 1, "coalescing must not re-publish"
+        assert len(server.work_futures) == 1
+        assert server._future_waiters.get(h) == K
+        work = solve_from(h, EASY)
+        await server.client_result_handler(
+            "result/ondemand", mc.encode_result_payload(h, work, PAYOUTS[0])
+        )
+        results = await asyncio.gather(*reqs)
+        assert all(r == {"work": work, "hash": h} for r in results)
+        # every side table torn down by the last waiter
+        assert server.work_futures == {}
+        assert server._dispatch_gates == {}
+        assert server._future_waiters == {}
+        assert sum(server._m_coalesce.collect().values()) == K - 1
+        # quota: all K requests charged (FakeClock: no refill happened)
+        bucket = await store.hgetall("quota:svc")
+        assert float(bucket["tokens"]) == pytest.approx(20.0 - K)
+        await server.close()
+
+    run(main())
+
+
+def test_no_coalesce_flag_restores_independent_admission():
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        server, store, t = await _bare_server(clock, coalesce=False)
+        h = random_hash()
+        reqs = [
+            asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+            ))
+            for _ in range(3)
+        ]
+        await settle()
+        # pre-coalescing semantics: still one dispatch (the work_futures
+        # dedup), gates unused, nothing counted
+        assert len(_work_publishes(t, h)) == 1
+        assert server._dispatch_gates == {}
+        assert sum(server._m_coalesce.collect().values()) == 0
+        work = solve_from(h, EASY)
+        await server.client_result_handler(
+            "result/ondemand", mc.encode_result_payload(h, work, PAYOUTS[0])
+        )
+        results = await asyncio.gather(*reqs)
+        assert all(r["work"] == work for r in results)
+        await server.close()
+
+    run(main())
+
+
+def test_coalesced_waiters_promote_when_the_dispatcher_fails():
+    """A shed/crashed dispatcher must not strand the requests gated behind
+    it: one of them promotes to dispatcher on its next pass."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        server, store, t = await _bare_server(clock)
+        h = random_hash()
+
+        # First dispatcher fails mid-dispatch: break its store once
+        real_set = store.set
+        fail = {"armed": True}
+
+        async def flaky_set(key, *a, **kw):
+            if fail["armed"] and key.startswith("work-type:"):
+                fail["armed"] = False
+                raise RuntimeError("store hiccup")
+            return await real_set(key, *a, **kw)
+
+        store.set = flaky_set
+        reqs = [
+            asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+            ))
+            for _ in range(3)
+        ]
+        await settle()
+        # the failed dispatcher errored out; a gated request promoted and
+        # re-dispatched — the hash is in flight again
+        assert len(server.work_futures) == 1
+        work = solve_from(h, EASY)
+        await server.client_result_handler(
+            "result/ondemand", mc.encode_result_payload(h, work, PAYOUTS[0])
+        )
+        results = await asyncio.gather(*reqs, return_exceptions=True)
+        served = [r for r in results if isinstance(r, dict)]
+        failed = [r for r in results if not isinstance(r, dict)]
+        assert len(served) == 2 and all(r["work"] == work for r in served)
+        assert len(failed) == 1  # the dispatcher's own 500
+        # 3 requests, 2 dispatch attempts (original + promoted): only the
+        # ONE request actually served by another's dispatch counts
+        assert sum(server._m_coalesce.collect().values()) == 1
+        assert server.work_futures == {} and server._dispatch_gates == {}
+        await server.close()
+
+    run(main())

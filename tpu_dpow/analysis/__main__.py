@@ -26,6 +26,8 @@ DPOW601  topic-contract      topic used in code but absent from the spec table
 DPOW602  topic-contract      spec topic exercised nowhere in code
 DPOW603  topic-contract      publish/subscribe not permitted by users.json ACLs
 DPOW604  topic-contract      ACL drift between spec / users.json / code defaults
+DPOW605  payload-grammar     binary frame in code missing/drifted in the spec table
+DPOW606  payload-grammar     spec binary-frame row no code declares
 DPOW701  flag-drift          config flag missing from docs/flags.md
 DPOW702  flag-drift          documented flag no config declares
 DPOW703  flag-drift          documented default != declared default

@@ -1,4 +1,4 @@
-"""DPOW601-604 topic/ACL-contract: the wire grammar stays machine-checked.
+"""DPOW601-606 topic/ACL/payload-contract: the wire grammar stays machine-checked.
 
 The MQTT topic table in docs/specification.md is the swarm's wire contract,
 and the ACL matrix exists in THREE places that must agree: the spec table,
@@ -11,6 +11,15 @@ checker makes that drift a lint failure instead of an incident:
   * DPOW602 — spec summary row no code publishes, subscribes, or builds;
   * DPOW603 — code publish/subscribe not permitted by any users.json ACL;
   * DPOW604 — ACL matrix drift between spec table / users.json / defaults.
+
+The payload grammar is checked the same both-ways way (PR 7): the binary
+wire codec's frame catalogue (``transport/wire.py`` FRAME_GRAMMAR — one
+header byte + body layout per kind) must match the binary-frame table in
+docs/specification.md field-for-field:
+
+  * DPOW605 — frame kind in code missing from the spec table, or its
+    header byte / body layout drifted from the documented row;
+  * DPOW606 — spec binary-frame row no code declares.
 
 Topic extraction is static: literal or f-string arguments of
 ``.publish(...)``/``.subscribe(...)``, any f-string whose leading text is a
@@ -273,6 +282,73 @@ def default_users_acls(project: Project) -> Optional[Dict[str, Dict[str, Tuple[s
     return out or None
 
 
+# -- binary frame grammar (DPOW605/606) --------------------------------
+
+#: package-dir-relative home of the binary codec's grammar literal
+WIRE_SOURCE = "transport/wire.py"
+
+#: | kind | `0xNN` | `layout` | rows of the spec's binary-frame table
+_FRAME_ROW_RE = re.compile(
+    r"^\|\s*`?([a-z][a-z0-9_]*)`?\s*\|\s*`?0x([0-9a-fA-F]{2})`?\s*\|\s*`?([^|`]*)`?\s*\|"
+)
+
+
+def frame_grammar_code(
+    project: Project,
+) -> Optional[Tuple[Dict[str, Tuple[int, str]], str, Dict[str, int]]]:
+    """The FRAME_GRAMMAR literal out of transport/wire.py:
+    (kind → (header byte, layout), source rel path, kind → line). None when
+    the module or the literal is absent (pre-v1 trees, fixtures)."""
+    src = next(
+        (s for s in project.sources() if s.rel.endswith(WIRE_SOURCE)), None
+    )
+    if src is None:
+        return None
+    for node in src.tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "FRAME_GRAMMAR"
+        ):
+            continue
+        try:
+            value = ast.literal_eval(node.value)
+        except ValueError:
+            return None
+        if not isinstance(value, dict):
+            return None
+        lines: Dict[str, int] = {}
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    lines[k.value] = k.lineno
+        out: Dict[str, Tuple[int, str]] = {}
+        for kind, spec in value.items():
+            if (
+                isinstance(kind, str)
+                and isinstance(spec, tuple)
+                and len(spec) == 2
+            ):
+                out[kind] = (int(spec[0]), str(spec[1]))
+        return out, src.rel, lines
+    return None
+
+
+def spec_frames(project: Project) -> Dict[str, Tuple[int, str, int]]:
+    """kind → (header byte, layout, line) from the spec's binary-frame
+    table (any markdown table whose second column is a `0xNN` byte)."""
+    text = project.doc(SPEC_DOC)
+    out: Dict[str, Tuple[int, str, int]] = {}
+    if text is None:
+        return out
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _FRAME_ROW_RE.match(line.strip())
+        if m:
+            out[m.group(1)] = (int(m.group(2), 16), m.group(3).strip(), i)
+    return out
+
+
 # -- the check ---------------------------------------------------------
 
 #: which broker principal a module subtree runs as (package-dir-relative
@@ -343,6 +419,46 @@ def check(project: Project) -> List[Finding]:
                         "DPOW602",
                         f"spec topic '{row}' is not published, subscribed, "
                         "or built anywhere in the package",
+                    )
+                )
+
+    code_frames = frame_grammar_code(project)
+    if code_frames is not None and have_spec:
+        grammar, wire_rel, lines = code_frames
+        doc_frames = spec_frames(project)
+        for kind, (byte, layout) in sorted(grammar.items()):
+            row = doc_frames.get(kind)
+            line = lines.get(kind, 1)
+            if row is None:
+                findings.append(
+                    Finding(
+                        wire_rel,
+                        line,
+                        "DPOW605",
+                        f"binary frame kind '{kind}' (0x{byte:02x}) is not "
+                        f"catalogued in the {spec_path} binary-frame table",
+                    )
+                )
+            elif (row[0], row[1]) != (byte, layout):
+                findings.append(
+                    Finding(
+                        wire_rel,
+                        line,
+                        "DPOW605",
+                        f"binary frame '{kind}' drifted: code has "
+                        f"0x{byte:02x} {layout!r} but {spec_path}:{row[2]} "
+                        f"documents 0x{row[0]:02x} {row[1]!r}",
+                    )
+                )
+        for kind, (byte, layout, line) in sorted(doc_frames.items()):
+            if kind not in grammar:
+                findings.append(
+                    Finding(
+                        spec_path,
+                        line,
+                        "DPOW606",
+                        f"spec binary frame '{kind}' (0x{byte:02x}) does "
+                        f"not exist in {WIRE_SOURCE} FRAME_GRAMMAR",
                     )
                 )
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import traceback
+from collections import OrderedDict
 from typing import Optional
 
 from .. import obs
@@ -27,7 +28,8 @@ from ..backend import WorkBackend, get_backend
 from ..models import WorkRequest, WorkType
 from ..resilience.clock import Clock, SystemClock
 from ..transport import Message, QOS_0, QOS_1, Transport
-from ..transport.mqtt_codec import encode_result_payload, parse_work_payload
+from ..transport import wire
+from ..transport.mqtt_codec import encode_result_payload
 from ..utils import nanocrypto as nc
 from ..utils.logging import get_logger
 from .config import ClientConfig
@@ -68,6 +70,12 @@ class DpowClient:
         # and the suffix of this worker's private sharded-dispatch lane
         # work/{type}/{worker_id}.
         self.worker_id = config.resolve_worker_id()
+        # Hashes whose work arrived as a binary v1 frame: the result is
+        # replied in the codec the dispatch spoke (the sender of a v1 frame
+        # has proven it parses v1 — no other negotiation channel exists for
+        # the result direction). Bounded LRU so cancelled dispatches can
+        # never accumulate.
+        self._v1_dispatched: "OrderedDict[str, None]" = OrderedDict()
         self._tasks: list = []
         self._metrics_runner = None
         self.metrics_port: Optional[int] = None  # bound port once serving
@@ -136,14 +144,27 @@ class DpowClient:
         )
 
     async def _send_result(self, request: WorkRequest, work: str) -> None:
+        trace_id = self._tracer.id_for(request.block_hash)
+        payload = None
+        version = "v0"
+        if self.config.codec == "v1" and request.block_hash in self._v1_dispatched:
+            del self._v1_dispatched[request.block_hash]
+            try:
+                payload = wire.encode_result(
+                    request.block_hash, work, self.config.payout_address,
+                    trace_id,
+                )
+                version = "v1"
+            except ValueError:
+                payload = None  # malformed field: reply legacy instead
+        if payload is None:
+            payload = encode_result_payload(
+                request.block_hash, work, self.config.payout_address, trace_id
+            )
         await self.transport.publish(
-            f"result/{request.work_type.value}",
-            encode_result_payload(
-                request.block_hash, work, self.config.payout_address,
-                self._tracer.id_for(request.block_hash),
-            ),
-            qos=QOS_0,
+            f"result/{request.work_type.value}", payload, qos=QOS_0
         )
+        wire.count_encoded(version, "result")
         self._m_results_published.inc(1, request.work_type.value)
         self._tracer.mark_hash(request.block_hash, "result")
 
@@ -231,6 +252,12 @@ class DpowClient:
                 "hashrate": self.config.declared_hashrate,
                 "work": self.config.work_type.topics,
             }
+            if self.config.codec == "v1":
+                # Wire-codec capability bit (transport/wire.py): the server
+                # sends this worker's lane binary v1 frames only after
+                # seeing it here. Omitted under --codec v0 — and a legacy
+                # server simply ignores the extra key.
+                payload["codec"] = wire.V1
         await self.transport.publish(
             "fleet/announce", json.dumps(payload), qos=QOS_1
         )
@@ -270,28 +297,48 @@ class DpowClient:
             self.handle_stats(msg.payload)
 
     async def handle_work(self, work_type: str, payload: str) -> None:
+        """One work message, either wire generation. A binary v1 frame may
+        be a BATCH (the coordinator packs everything a lane gets per flush
+        into one publish); the items unbatch here into the existing
+        queue_work API one at a time, so the engine sees no difference."""
         try:
-            block_hash, difficulty_hex, trace_id, nonce_range = (
-                parse_work_payload(payload)
-            )
-            request = WorkRequest(
-                block_hash=block_hash,
-                difficulty=int(difficulty_hex, 16),
-                work_type=WorkType(work_type),
-                # Sharded-dispatch assignment (fleet/planner.py): the
-                # engine pins its scan base to the shard start. A legacy
-                # build of this client parses the same payload and simply
-                # never sees the field — it races the full space.
-                nonce_range=nonce_range,
-            )
-        except (ValueError, nc.InvalidBlockHash, nc.InvalidDifficulty) as e:
-            logger.warning("could not parse work message %r: %s", payload, e)
+            items = wire.decode_work_any(payload)
+        except ValueError as e:
+            logger.warning("could not parse work message %.120r: %s", payload, e)
             return
-        self._m_work_received.inc(1, work_type)
-        if trace_id is not None:
-            self._tracer.alias(request.block_hash, trace_id)
-        self._tracer.mark_hash(request.block_hash, "dispatch")
-        await self.work_handler.queue_work(request)
+        is_v1 = wire.wire_version(payload) == wire.V1
+        for block_hash, difficulty, trace_id, nonce_range in items:
+            try:
+                request = WorkRequest(
+                    # v0 parses to a 16-hex string, v1 to a native int
+                    # (wire.WorkItem); WorkRequest canonicalizes the hash.
+                    block_hash=block_hash,
+                    difficulty=(
+                        int(difficulty, 16) if isinstance(difficulty, str)
+                        else difficulty
+                    ),
+                    work_type=WorkType(work_type),
+                    # Sharded-dispatch assignment (fleet/planner.py): the
+                    # engine pins its scan base to the shard start. A legacy
+                    # build of this client parses the same payload and simply
+                    # never sees the field — it races the full space.
+                    nonce_range=nonce_range,
+                )
+            except (ValueError, nc.InvalidBlockHash, nc.InvalidDifficulty) as e:
+                logger.warning("bad work item in %.120r: %s", payload, e)
+                continue
+            self._m_work_received.inc(1, work_type)
+            if is_v1 and self.config.codec == "v1":
+                # Under --codec v0 the reply-in-kind marker is dead state
+                # (_send_result never consumes it) — skip the bookkeeping.
+                self._v1_dispatched[request.block_hash] = None
+                self._v1_dispatched.move_to_end(request.block_hash)
+                while len(self._v1_dispatched) > 4096:
+                    self._v1_dispatched.popitem(last=False)
+            if trace_id is not None:
+                self._tracer.alias(request.block_hash, trace_id)
+            self._tracer.mark_hash(request.block_hash, "dispatch")
+            await self.work_handler.queue_work(request)
 
     def handle_stats(self, payload: str) -> None:
         """Server acknowledgment of accepted work (reference :87-95)."""

@@ -62,6 +62,13 @@ class ClientConfig:
     # Fleet identity; must be unique per worker process and topic-safe.
     # "" = auto: derived from client_id (or payout + pid).
     worker_id: str = ""
+    # Wire codec (transport/wire.py): "v1" advertises the binary-frame
+    # capability on the announce — the server then sends this worker's
+    # lane batched binary frames, and results for v1-dispatched work are
+    # replied in v1. "v0" pins this worker to the legacy ASCII grammar
+    # (it never advertises and never emits binary frames; inbound v1 is
+    # still parsed, so a stale flag cannot brick reception).
+    codec: str = "v1"
     log_file: Optional[str] = None
     # Persistent XLA compilation cache dir ("" = off). A restarted worker
     # reloads the launch-shape ladder's executables instead of re-paying
@@ -90,6 +97,8 @@ class ClientConfig:
             raise ValueError("--backend_hang_timeout must be >= 0 (0 = off)")
         if self.fleet_announce_interval <= 0:
             raise ValueError("--fleet_announce_interval must be > 0")
+        if self.codec not in ("v1", "v0"):
+            raise ValueError("--codec must be 'v1' or 'v0'")
         if self.payout_address:
             self.payout_address = self.payout_address.replace("xrb_", "nano_")
             nc.validate_account(self.payout_address)
@@ -203,6 +212,10 @@ def parse_args(argv=None) -> ClientConfig:
     p.add_argument("--worker_id", default=c.worker_id,
                    help="fleet identity (topic-safe, unique per process; "
                    "default derives from --client_id)")
+    p.add_argument("--codec", default=c.codec, choices=["v1", "v0"],
+                   help="wire codec: v1 = advertise the binary-frame "
+                   "capability (lane work arrives batched binary, results "
+                   "reply in kind), v0 = legacy ASCII payloads only")
     p.add_argument("--log_file", default=None)
     p.add_argument("--compilation_cache", default=c.compilation_cache,
                    help="persistent XLA compilation cache dir: a restarted "

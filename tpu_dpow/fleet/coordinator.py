@@ -30,10 +30,11 @@ gauge tracks how many workers the last dispatch set racing (1 shard = 1).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import obs
 from ..transport import QOS_0
+from ..transport import wire
 from ..transport.mqtt_codec import encode_work_payload
 from ..utils.logging import get_logger
 from .cover import BROADCAST_OWNER, CoverageTracker
@@ -61,6 +62,7 @@ class FleetCoordinator:
         *,
         clock,
         enabled: bool = True,
+        codec_v1: bool = True,
     ):
         self.registry = registry
         self.planner = planner
@@ -68,6 +70,13 @@ class FleetCoordinator:
         self.transport = transport
         self.clock = clock
         self.enabled = enabled
+        # Wire-codec policy (transport/wire.py): with codec_v1 the server
+        # emits binary v1 frames on the private lanes of workers that
+        # ANNOUNCED the capability — one batched frame per lane per flush —
+        # and ASCII v0 everywhere else (broadcast topics have an unknown
+        # audience; legacy racers must keep parsing byte-for-byte). False
+        # (--codec v0) pins every publish to the legacy grammar.
+        self.codec_v1 = codec_v1
         reg = obs.get_registry()
         self._m_dispatch = reg.counter(
             "dpow_fleet_dispatch_total",
@@ -79,6 +88,92 @@ class FleetCoordinator:
             "dpow_fleet_redundancy_ratio",
             "Workers racing the most recent dispatch (sharded = 1 per "
             "nonce, broadcast = the whole registered fleet)")
+
+    # -- codec-aware publish primitives --------------------------------
+
+    def _peer_v1(self, worker_id: str) -> bool:
+        """May this worker's private lane carry binary v1 frames? Only if
+        the server's codec policy allows it AND the worker advertised the
+        capability on its announce (downgrade negotiation, docs/
+        specification.md)."""
+        if not self.codec_v1:
+            return False
+        info = self.registry.get(worker_id)
+        return info is not None and info.codec >= 1
+
+    async def _publish_lane(
+        self,
+        work_type: str,
+        worker_id: str,
+        items: List[Tuple[str, int, Optional[str], Optional[tuple]]],
+    ) -> None:
+        """Everything one worker gets this flush, on its private lane: ONE
+        v1 frame (batched past one item) for a v1-capable peer, else one
+        legacy ASCII publish per item. A v1 encode failure (malformed
+        field) falls back to v0 rather than dropping the dispatch."""
+        topic = work_topic(work_type, worker_id)
+        if self._peer_v1(worker_id):
+            try:
+                payload = wire.encode_work_items(items)
+            except ValueError:
+                logger.warning(
+                    "v1 encode failed for lane %s; falling back to v0", topic
+                )
+            else:
+                wire.count_encoded(
+                    "v1", "work" if len(items) == 1 else "work_batch", len(items)
+                )
+                await self.transport.publish(topic, payload, qos=QOS_0)
+                return
+        elif self.codec_v1:
+            wire.M_DOWNGRADE.inc()
+        for block_hash, difficulty, trace_id, nonce_range in items:
+            await self.transport.publish(
+                topic,
+                encode_work_payload(block_hash, difficulty, trace_id, nonce_range),
+                qos=QOS_0,
+            )
+            wire.count_encoded("v0", "work")
+
+    async def _publish_assignments(
+        self,
+        block_hash: str,
+        difficulty: int,
+        work_type: str,
+        trace_id: Optional[str],
+        assignments,
+    ) -> None:
+        """Fan one dispatch's shard table out, grouped per worker lane so a
+        worker holding several shards receives one batched frame."""
+        by_worker: Dict[str, list] = {}
+        for a in assignments:
+            by_worker.setdefault(a.worker_id, []).append(a)
+        for worker_id, shards in by_worker.items():
+            await self._publish_lane(
+                work_type,
+                worker_id,
+                [
+                    (block_hash, difficulty, trace_id, (a.start, a.length))
+                    for a in shards
+                ],
+            )
+
+    async def _publish_broadcast(
+        self,
+        work_type: str,
+        block_hash: str,
+        difficulty: int,
+        trace_id: Optional[str],
+        nonce_range: Optional[tuple] = None,
+    ) -> None:
+        """Shared-topic publish: ALWAYS legacy ASCII — the audience is
+        unknown and may include pre-v1 racers."""
+        await self.transport.publish(
+            work_topic(work_type),
+            encode_work_payload(block_hash, difficulty, trace_id, nonce_range),
+            qos=QOS_0,
+        )
+        wire.count_encoded("v0", "work")
 
     # -- dispatch ------------------------------------------------------
 
@@ -95,15 +190,9 @@ class FleetCoordinator:
             mode=BROADCAST, racers=1
         )
         if plan.mode == SHARDED:
-            for a in plan.assignments:
-                await self.transport.publish(
-                    work_topic(work_type, a.worker_id),
-                    encode_work_payload(
-                        block_hash, difficulty, trace_id,
-                        (a.start, a.length),
-                    ),
-                    qos=QOS_0,
-                )
+            await self._publish_assignments(
+                block_hash, difficulty, work_type, trace_id, plan.assignments
+            )
             self.cover.begin(
                 block_hash, work_type, difficulty, plan.assignments,
                 self.clock.time(),
@@ -115,10 +204,8 @@ class FleetCoordinator:
                 "sharded %s across %d workers", block_hash, len(plan.assignments)
             )
         else:
-            await self.transport.publish(
-                work_topic(work_type),
-                encode_work_payload(block_hash, difficulty, trace_id),
-                qos=QOS_0,
+            await self._publish_broadcast(
+                work_type, block_hash, difficulty, trace_id
             )
             self.cover.forget(block_hash)  # a re-target may downgrade modes
             self._m_dispatch.inc(1, BROADCAST)
@@ -143,11 +230,14 @@ class FleetCoordinator:
             # the pre-fleet supervisor did; coordination is abandoned so a
             # later winner is not mis-attributed to a stale shard table.
             self.cover.forget(block_hash)
-            payload = encode_work_payload(block_hash, difficulty, trace_id)
-            await self.transport.publish(work_topic(work_type), payload, qos=QOS_0)
+            await self._publish_broadcast(
+                work_type, block_hash, difficulty, trace_id
+            )
             if hedged:
                 other = "precache" if work_type == "ondemand" else "ondemand"
-                await self.transport.publish(work_topic(other), payload, qos=QOS_0)
+                await self._publish_broadcast(
+                    other, block_hash, difficulty, trace_id
+                )
             return True
         plan = self.cover.republish_plan(block_hash)
         if plan is None:
@@ -155,17 +245,21 @@ class FleetCoordinator:
         lane, orphaned, rebroadcast = plan
         now = self.clock.time()
         published = False
+        # Everything lane-bound this heal is COLLECTED first and flushed
+        # grouped per worker at the end: an owner's re-publish and a shard
+        # it just took over ride one batched frame instead of two publishes
+        # (transport/wire.py WORK_BATCH; v0 peers get per-item publishes).
+        # Re-cover BOOKKEEPING (cover.reassigned + the recovered counter)
+        # is deferred with the publish: recording a new owner before its
+        # lane publish lands would let a transport failure mark a shard
+        # covered by a worker that never heard of it.
+        pending: Dict[str, list] = {}
+        recover_after: Dict[str, list] = {}
         for a in lane:
             # Freshest shard per live owner, to its own lane: the original
             # QoS-0 publish may have fired mid-reconnect. A re-send of the
             # range the client already scans dedups clean (no rebase).
-            await self.transport.publish(
-                work_topic(work_type, a.worker_id),
-                encode_work_payload(
-                    block_hash, difficulty, trace_id, (a.start, a.length)
-                ),
-                qos=QOS_0,
-            )
+            pending.setdefault(a.worker_id, []).append(a)
             published = True
         # Reassignment prefers workers with no shard of this dispatch yet:
         # handing a second shard to a current assignee rebases its single
@@ -177,17 +271,12 @@ class FleetCoordinator:
             ) or self.planner.reassign(a, work_type=work_type)
             if replacement is not None:
                 taken.add(replacement.worker_id)
-                await self.transport.publish(
-                    work_topic(work_type, replacement.worker_id),
-                    encode_work_payload(
-                        block_hash, difficulty, trace_id,
-                        (replacement.start, replacement.length),
-                    ),
-                    qos=QOS_0,
+                pending.setdefault(replacement.worker_id, []).append(replacement)
+                recover_after.setdefault(replacement.worker_id, []).append(
+                    (a, replacement)
                 )
-                self.cover.reassigned(block_hash, a, replacement.worker_id, now)
                 logger.info(
-                    "re-covered shard [%016x+%016x] of %s: %s -> %s",
+                    "re-covering shard [%016x+%016x] of %s: %s -> %s",
                     a.start, a.length, block_hash, a.worker_id,
                     replacement.worker_id,
                 )
@@ -197,12 +286,9 @@ class FleetCoordinator:
                 # and races the full space (correct either way). Marked in
                 # the cover table so later fires re-broadcast WITHOUT
                 # re-counting the same shard as freshly re-covered.
-                await self.transport.publish(
-                    work_topic(work_type),
-                    encode_work_payload(
-                        block_hash, difficulty, trace_id, (a.start, a.length)
-                    ),
-                    qos=QOS_0,
+                await self._publish_broadcast(
+                    work_type, block_hash, difficulty, trace_id,
+                    (a.start, a.length),
                 )
                 self.cover.reassigned(
                     block_hash, a, BROADCAST_OWNER, now
@@ -211,16 +297,28 @@ class FleetCoordinator:
                     "broadcast orphaned shard [%016x+%016x] of %s (no live "
                     "worker to reassign)", a.start, a.length, block_hash,
                 )
-            self._m_recovered.inc()
-            published = True
+                self._m_recovered.inc()
+                published = True
         for a in rebroadcast:
-            await self.transport.publish(
-                work_topic(work_type),
-                encode_work_payload(
-                    block_hash, difficulty, trace_id, (a.start, a.length)
-                ),
-                qos=QOS_0,
+            await self._publish_broadcast(
+                work_type, block_hash, difficulty, trace_id,
+                (a.start, a.length),
             )
+            published = True
+        for worker_id, shards in pending.items():
+            await self._publish_lane(
+                work_type,
+                worker_id,
+                [
+                    (block_hash, difficulty, trace_id, (a.start, a.length))
+                    for a in shards
+                ],
+            )
+            # The lane publish landed: NOW the re-covers it carried are
+            # real — record the new owners and count them.
+            for orig, repl in recover_after.get(worker_id, ()):
+                self.cover.reassigned(block_hash, orig, repl.worker_id, now)
+                self._m_recovered.inc()
             published = True
         return published
 

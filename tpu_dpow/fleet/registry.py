@@ -71,6 +71,10 @@ class WorkerInfo:
     work_types: tuple = ("precache", "ondemand")
     last_seen: float = 0.0  # registry clock time of the last announce/win
     announces: int = 0
+    #: Highest wire-codec version the worker advertised (transport/wire.py):
+    #: 0 = legacy ASCII only. Re-negotiated on EVERY announce, so a worker
+    #: restarted with --codec v0 downgrades its lane immediately.
+    codec: int = 0
 
     @property
     def hashrate(self) -> float:
@@ -152,6 +156,7 @@ class WorkerRegistry:
                     ) or ("precache", "ondemand"),
                     last_seen=now,
                     announces=int(record.get("announces", 0) or 0),
+                    codec=int(record.get("codec", 0) or 0),
                 )
             except (TypeError, ValueError):
                 logger.warning("dropping corrupt fleet record %s", key)
@@ -171,6 +176,7 @@ class WorkerRegistry:
                 "ema_hashrate": repr(info.ema_hashrate),
                 "work_types": "+".join(info.work_types),
                 "announces": str(info.announces),
+                "codec": str(info.codec),
                 # Coarse wall-clock stamp, for cross-restart store hygiene
                 # only (monotonic clocks do not survive the process).
                 # dpowlint: disable=DPOW101 — deliberate wall clock, see above
@@ -234,6 +240,12 @@ class WorkerRegistry:
         work_types = data.get("work")
         if isinstance(work_types, list) and work_types:
             info.work_types = tuple(str(t) for t in work_types)
+        try:
+            # Absent ⇒ 0: a legacy announce (or a --codec v0 restart) must
+            # RESET the capability, not inherit last session's advertisement.
+            info.codec = max(int(data.get("codec", 0) or 0), 0)
+        except (TypeError, ValueError):
+            info.codec = 0
         info.last_seen = self.clock.time()
         info.announces += 1
         self._m_announces.inc(1, "join" if fresh else "refresh")

@@ -24,7 +24,7 @@ import sys
 import time
 from typing import Dict, Optional
 
-from ..transport import QOS_0, Transport
+from ..transport import QOS_0, Transport, wire
 from ..transport.tcp import TcpTransport
 
 
@@ -76,13 +76,24 @@ class LatencyProbe:
         now = time.monotonic()
         self._prune(now)
         if topic.startswith("work/"):
-            block_hash = payload.split(",")[0]
-            self.work_sent.setdefault(block_hash, now)
+            # work/# includes the per-worker lanes, which may carry binary
+            # v1 (batched) frames on a negotiated fleet — decode by version
+            # so the probe keeps correlating mixed traffic. Hash case is
+            # canonicalized: v1 decodes lowercase, v0 ships uppercase.
+            try:
+                for item in wire.decode_work_any(payload):
+                    self.work_sent.setdefault(item[0].upper(), now)
+            except ValueError:
+                pass
+            return
         elif topic.startswith("result/"):
             # get, not pop: the cancel fan-out for this hash arrives after
             # the winning result and still needs the start time; _prune is
             # what keeps work_sent bounded.
-            block_hash = payload.split(",")[0]
+            try:
+                block_hash = wire.decode_result_any(payload)[0].upper()
+            except ValueError:
+                return
             start = self.work_sent.get(block_hash)
             if start is not None:
                 delta = now - start

@@ -41,7 +41,7 @@ from ..resilience import DispatchSupervisor, SystemClock
 from ..sched import AdmissionController
 from ..store import MemoryStore, Store, atomic_write
 from ..transport import Message, QOS_0, QOS_1, Transport
-from ..transport.mqtt_codec import parse_result_payload
+from ..transport import wire
 from ..utils import nanocrypto as nc
 from ..utils.logging import get_logger
 from ..utils.throttle import Throttler
@@ -128,6 +128,15 @@ class DpowServer:
         # Window ticket per dispatched hash; lives and dies with the
         # work_futures entry (released in _drop_dispatch_state).
         self._dispatch_tickets: Dict[str, object] = {}
+        # Same-hash request coalescing (ROADMAP item 5): per hash, the gate
+        # a mid-dispatch request holds while it acquires admission and
+        # publishes. Concurrent same-hash arrivals wait on the gate and
+        # then attach as extra waiters — N requests, ONE window slot, ONE
+        # backend dispatch — instead of each queueing for admission. The
+        # entry exists only while its dispatcher is between gate-register
+        # and work_futures-install; the refcounted waiter teardown below
+        # (last waiter cancels the dispatch) is unchanged.
+        self._dispatch_gates: Dict[str, asyncio.Future] = {}
         # Fleet coordination (tpu_dpow/fleet/): every work publish routes
         # through the coordinator, which shards the nonce space across the
         # announced worker fleet (disjoint hashrate-weighted ranges) and
@@ -150,6 +159,7 @@ class DpowServer:
             transport,
             clock=self.clock,
             enabled=config.fleet,
+            codec_v1=config.codec != "v0",
         )
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
@@ -189,6 +199,10 @@ class DpowServer:
         self._m_republished = reg.counter(
             "dpow_server_work_republished_total",
             "Lost work publishes healed by the republish loop")
+        self._m_coalesce = reg.counter(
+            "dpow_coalesce_total",
+            "On-demand requests served by another request's dispatch "
+            "instead of their own, by how they joined", ("outcome",))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -459,7 +473,11 @@ class DpowServer:
 
     async def client_result_handler(self, topic: str, content: str) -> None:
         try:
-            block_hash, work, client, trace_id = parse_result_payload(content)
+            # Version-routed (transport/wire.py): a v1-capable worker
+            # answers a binary dispatch with a binary RESULT frame — fixed
+            # width nonce instead of a hex round-trip — while legacy ASCII
+            # results parse byte-for-byte as before.
+            block_hash, work, client, trace_id = wire.decode_result_any(content)
         except ValueError:
             return
 
@@ -830,110 +848,164 @@ class DpowServer:
         created = None
         ticket = None
         # One deadline for the whole dispatch: any time spent waiting in
-        # the admission queue below comes OUT of this request's budget —
-        # a caller that asked for 10 s must never wait ~20 (queue + work).
+        # the admission queue — or coalesced behind another request's
+        # pending dispatch — comes OUT of this request's budget; a caller
+        # that asked for 10 s must never wait ~20 (queue + work).
         deadline = self.clock.time() + timeout
-        if block_hash not in self.work_futures:
-            # Admission window (sched/window.py): a would-be dispatcher
-            # needs a slot before it may create the dispatch. This may
-            # wait in the fair queue (backpressure) or raise Busy (shed /
-            # rejected → 429). With the default unbounded window it
-            # grants synchronously — no await-gap is introduced.
-            ticket = await self.admission.acquire_dispatch(
-                block_hash, service,
-                difficulty=difficulty,
-                deadline=deadline,
-                over_quota=over_quota,
+        coalesced = False  # this request counts in dpow_coalesce_total once
+        while block_hash not in self.work_futures:
+            gate = (
+                self._dispatch_gates.get(block_hash)
+                if self.config.coalesce else None
             )
-            timeout = max(deadline - self.clock.time(), 0.01)
-            if block_hash in self.work_futures:
-                # A concurrent dispatcher won the hash while we waited in
-                # the queue: the dispatch exists, hand the slot back and
-                # join it as a plain waiter below.
-                self.admission.release(ticket)
-                ticket = None
-        if block_hash not in self.work_futures:
-            # Reserve the entry synchronously — no await sits between the
-            # membership check and this assignment — so concurrent base- and
-            # raised-difficulty dispatches for the same hash cannot both
-            # enter this block, double-publish, and clobber each other's
-            # block-difficulty entries (the base path's delete below would
-            # erase a raised entry and fail its final validation).
-            created = asyncio.get_running_loop().create_future()
-            self.work_futures[block_hash] = created
-            # The window slot travels with the dispatch state from here on:
-            # _drop_dispatch_state releases it (every teardown path).
-            self._dispatch_tickets[block_hash] = ticket
-            ticket = None
-            self._dispatched_difficulty[block_hash] = difficulty
-            self._m_dispatches.set(len(self.work_futures))
-            self._tracer.mark_hash(block_hash, "queue")
-            # Supervision starts with the entry (deadline = this waiter's
-            # budget); the supervisor holds fire until the first publish is
-            # stamped via dispatched(), so it cannot jump the dispatcher's
-            # difficulty-entry serialization below.
-            self.supervisor.track(block_hash, deadline)
+            if gate is not None:
+                # COALESCE: another request is mid-dispatch for this very
+                # hash (admission queue, store writes, publish). Attaching
+                # behind its gate instead of queueing for our own window
+                # slot is the whole point — N same-hash arrivals cost ONE
+                # slot and ONE publish. Quota was already charged per
+                # request upstream. Shielded: our per-request timeout must
+                # not cancel the shared gate under the other waiters.
+                # (Counted after the loop, not here: a gated request that
+                # ends up PROMOTING to dispatcher was not served by another
+                # request's dispatch and must not inflate the metric.)
+                coalesced = True
+                remaining = max(deadline - self.clock.time(), 0.001)
+                try:
+                    await asyncio.wait_for(asyncio.shield(gate), timeout=remaining)
+                except asyncio.TimeoutError:
+                    raise RequestTimeout()
+                # Loop: the dispatch now exists (attach below), or the
+                # dispatcher failed — in which case one of the gated
+                # requests PROMOTES to dispatcher on its next pass, so a
+                # single shed/crashed dispatcher cannot strand the rest.
+                continue
+            gate = asyncio.get_running_loop().create_future()
+            if self.config.coalesce:
+                self._dispatch_gates[block_hash] = gate
             try:
-                if account:
-                    self._spawn(
-                        self.store.set(
-                            f"account:{account}", block_hash, expire=self.config.account_expiry
+                # Admission window (sched/window.py): a would-be dispatcher
+                # needs a slot before it may create the dispatch. This may
+                # wait in the fair queue (backpressure) or raise Busy (shed
+                # / rejected → 429). With the default unbounded window it
+                # grants synchronously — no await-gap is introduced.
+                ticket = await self.admission.acquire_dispatch(
+                    block_hash, service,
+                    difficulty=difficulty,
+                    deadline=deadline,
+                    over_quota=over_quota,
+                )
+                if block_hash in self.work_futures:
+                    # A concurrent dispatcher won the hash while we waited
+                    # in the queue (reachable with --no_coalesce, where no
+                    # gate serializes dispatchers): the dispatch exists,
+                    # hand the slot back and join it as a plain waiter.
+                    self.admission.release(ticket)
+                    ticket = None
+                    break
+                # Reserve the entry synchronously — no await sits between
+                # the membership check and this assignment — so concurrent
+                # base- and raised-difficulty dispatches for the same hash
+                # cannot both enter this block, double-publish, and clobber
+                # each other's block-difficulty entries (the base path's
+                # delete below would erase a raised entry and fail its
+                # final validation).
+                created = asyncio.get_running_loop().create_future()
+                self.work_futures[block_hash] = created
+                # The window slot travels with the dispatch state from here
+                # on: _drop_dispatch_state releases it (every teardown path).
+                self._dispatch_tickets[block_hash] = ticket
+                ticket = None
+                self._dispatched_difficulty[block_hash] = difficulty
+                self._m_dispatches.set(len(self.work_futures))
+                self._tracer.mark_hash(block_hash, "queue")
+                # Supervision starts with the entry (deadline = this
+                # waiter's budget); the supervisor holds fire until the
+                # first publish is stamped via dispatched(), so it cannot
+                # jump the dispatcher's difficulty-entry serialization
+                # below.
+                self.supervisor.track(block_hash, deadline)
+                try:
+                    if account:
+                        self._spawn(
+                            self.store.set(
+                                f"account:{account}", block_hash, expire=self.config.account_expiry
+                            )
                         )
-                    )
-                await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
-                                     expire=self.config.block_expiry)
-                # Serialized with concurrent raisers (_raise_lock): a raiser
-                # that slipped in while this dispatcher was suspended in the
-                # store writes above has already bumped `block-difficulty:`
-                # — writing (or, worse, deleting) our weaker target AFTER
-                # its bump would make the result handler accept too-weak
-                # work and bounce the raiser through RetryRequest, the exact
-                # hole the retarget path exists to close. Under the lock the
-                # in-memory high-water mark is authoritative.
-                async with self._difficulty_lock(block_hash):
-                    effective = max(
-                        difficulty,
-                        self._dispatched_difficulty.get(block_hash, difficulty),
-                    )
-                    if effective != self.config.base_difficulty:
-                        await self.store.set(
-                            f"block-difficulty:{block_hash}",
-                            f"{effective:016x}",
-                            expire=self.config.difficulty_expiry,
+                    await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
+                                         expire=self.config.block_expiry)
+                    # Serialized with concurrent raisers (_raise_lock): a
+                    # raiser that slipped in while this dispatcher was
+                    # suspended in the store writes above has already bumped
+                    # `block-difficulty:` — writing (or, worse, deleting)
+                    # our weaker target AFTER its bump would make the result
+                    # handler accept too-weak work and bounce the raiser
+                    # through RetryRequest, the exact hole the retarget path
+                    # exists to close. Under the lock the in-memory
+                    # high-water mark is authoritative.
+                    async with self._difficulty_lock(block_hash):
+                        effective = max(
+                            difficulty,
+                            self._dispatched_difficulty.get(block_hash, difficulty),
                         )
-                    else:
-                        # A previous raised-difficulty dispatch for this hash
-                        # may have timed out inside the 120 s TTL; its
-                        # leftover entry would make the result handler
-                        # validate THIS base-difficulty dispatch against the
-                        # old higher target and discard valid work. Clear it
-                        # so validation matches what was asked for.
-                        await self.store.delete(f"block-difficulty:{block_hash}")
-                    # Publish at the SAME effective target, inside the lock:
-                    # the raiser's own QOS_0 publish can be lost, and a
-                    # worker arriving between the two publishes would
-                    # otherwise grind at a target the result handler no
-                    # longer accepts — with nothing left to re-publish.
-                    # Routed through the fleet coordinator: sharded across
-                    # the announced fleet or broadcast (registry too small).
-                    await self.fleet.publish_work(
-                        block_hash, effective, WorkType.ONDEMAND.value,
-                        self._tracer.id_for(block_hash),
-                    )
-                    self.supervisor.dispatched(block_hash)
-                    self._tracer.mark_hash(block_hash, "publish")
-            except BaseException:
-                # A failed dispatch must not leave a never-resolved future
-                # that later requests for this hash would silently wait on.
-                # Identity-guarded: by the time this cleanup runs, a waiter's
-                # teardown may already have removed our future and a NEW
-                # dispatch installed its own — popping by key would destroy
-                # the successor's future out from under it.
-                if self.work_futures.get(block_hash) is created:
-                    self._drop_dispatch_state(block_hash)
-                if not created.done():
-                    created.cancel()
-                raise
+                        if effective != self.config.base_difficulty:
+                            await self.store.set(
+                                f"block-difficulty:{block_hash}",
+                                f"{effective:016x}",
+                                expire=self.config.difficulty_expiry,
+                            )
+                        else:
+                            # A previous raised-difficulty dispatch for this
+                            # hash may have timed out inside the 120 s TTL;
+                            # its leftover entry would make the result
+                            # handler validate THIS base-difficulty dispatch
+                            # against the old higher target and discard
+                            # valid work. Clear it so validation matches
+                            # what was asked for.
+                            await self.store.delete(f"block-difficulty:{block_hash}")
+                        # Publish at the SAME effective target, inside the
+                        # lock: the raiser's own QOS_0 publish can be lost,
+                        # and a worker arriving between the two publishes
+                        # would otherwise grind at a target the result
+                        # handler no longer accepts — with nothing left to
+                        # re-publish. Routed through the fleet coordinator:
+                        # sharded across the announced fleet or broadcast
+                        # (registry too small).
+                        await self.fleet.publish_work(
+                            block_hash, effective, WorkType.ONDEMAND.value,
+                            self._tracer.id_for(block_hash),
+                        )
+                        self.supervisor.dispatched(block_hash)
+                        self._tracer.mark_hash(block_hash, "publish")
+                except BaseException:
+                    # A failed dispatch must not leave a never-resolved
+                    # future that later requests for this hash would
+                    # silently wait on. Identity-guarded: by the time this
+                    # cleanup runs, a waiter's teardown may already have
+                    # removed our future and a NEW dispatch installed its
+                    # own — popping by key would destroy the successor's
+                    # future out from under it.
+                    if self.work_futures.get(block_hash) is created:
+                        self._drop_dispatch_state(block_hash)
+                    if not created.done():
+                        created.cancel()
+                    raise
+            finally:
+                # Open the gate LAST — success or failure — so coalesced
+                # requests either find the installed dispatch or promote.
+                if self._dispatch_gates.get(block_hash) is gate:
+                    del self._dispatch_gates[block_hash]
+                if not gate.done():
+                    gate.set_result(None)
+            break
+        timeout = max(deadline - self.clock.time(), 0.01)
+        if created is None and self.config.coalesce:
+            # This request is served by someone else's dispatch — exactly
+            # once per coalesced request: "gated" if it waited behind a
+            # pending dispatcher, "attached" if the dispatch was already
+            # live. A request that dispatched itself (created is not None,
+            # gated-then-promoted included) counts nothing.
+            self._m_coalesce.inc(1, "gated" if coalesced else "attached")
         # The dispatcher holds its OWN future: during its dispatch awaits it
         # is not yet counted as a waiter, so an impatient concurrent waiter
         # may have torn the map entry down already — a key lookup here would

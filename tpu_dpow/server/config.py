@@ -102,6 +102,17 @@ class ServerConfig:
     # the expected solve within this many seconds, leaving the rest free
     # for concurrent dispatches. 0 = the whole live fleet every time.
     fleet_horizon: float = 0.0
+    # -- wire codec & coalescing (transport/wire.py, docs/specification.md)
+    # "v1": emit binary v1 frames (batched) on the lanes of workers that
+    # announced the capability; broadcast topics and non-advertising peers
+    # stay on the legacy ASCII grammar. "v0": never emit binary frames
+    # (inbound v1 results are still parsed — reception needs no flag).
+    codec: str = "v1"
+    # Same-hash request coalescing: a second on-demand request for a hash
+    # whose dispatch is pending or in flight attaches as an extra waiter
+    # (quota still charged per request) instead of queueing for its own
+    # admission slot. False restores the pre-coalescing admission path.
+    coalesce: bool = True
     log_file: Optional[str] = None
 
 
@@ -175,6 +186,14 @@ def parse_args(argv=None) -> ServerConfig:
                    help="right-size each dispatch to the workers needed to "
                    "cover the expected solve in this many seconds "
                    "(0 = use the whole live fleet per dispatch)")
+    p.add_argument("--codec", default=c.codec, choices=["v1", "v0"],
+                   help="wire codec policy: v1 = binary frames on the "
+                   "lanes of capability-announcing workers (batched), "
+                   "v0 = legacy ASCII payloads everywhere")
+    p.add_argument("--no_coalesce", dest="coalesce", action="store_false",
+                   help="dispatch same-hash on-demand requests through "
+                   "the admission queue independently instead of "
+                   "attaching them to the pending dispatch")
     p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
                    help="seconds between public statistics broadcasts "
                    "(reference: fixed 300)")
