@@ -4,8 +4,11 @@
 # the checks the stdlib can do — a full-tree compile (syntax) plus pyflakes
 # or flake8 when either exists — rather than skipping silently. Either way
 # the run finishes with dpowlint (python -m tpu_dpow.analysis): the
-# project's own AST invariant checkers for the Clock/async/metrics/topic
-# contracts (docs/analysis.md).
+# project's own AST invariant checkers for the Clock/async/metrics/topic/
+# flag contracts plus the flow-sensitive DPOW801-803 concurrency pass
+# (await-interference, lock-order, untrusted-input — docs/analysis.md).
+# The runtime half, the dpowsan interleaving replay, runs in
+# scripts/run_tier1.sh (DPOWSAN headline) and on demand via --san.
 #
 #   scripts/lint.sh [paths...]     # default: the package + tests + benchmarks
 set -uo pipefail

@@ -48,4 +48,32 @@ else
         echo "DPOWLINT=error (rc=$dlrc)"
     fi
 fi
+# dpowsan headline (ISSUE 8): seeded interleaving replay of the coalescing
+# and fleet re-cover e2e scenarios on the real DpowServer — the runtime
+# confirmer for the DPOW801 race class (docs/analysis.md). Seed count
+# rides the sanitizer's OWN env resolution (_env_int), so a malformed
+# DPOW_SAN_SEEDS degrades to the default here exactly as it does for
+# python -m tpu_dpow.analysis --san.
+SAN_SEEDS=$(python -c "from tpu_dpow.analysis.sanitizer import _env_int; print(_env_int('DPOW_SAN_SEEDS', 20))" 2>/dev/null || echo 20)
+DPOWSAN_OUT=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -c "
+import sys
+from tpu_dpow.analysis import sanitizer
+report = sanitizer.run_seeds(sanitizer._env_int('DPOW_SAN_SEEDS', 20))
+print(report.render())
+sys.exit(1 if report.failures else 0)
+" 2>&1)
+sanrc=$?
+if [ "$sanrc" -eq 0 ]; then
+    echo "DPOWSAN=clean seeds=${SAN_SEEDS}"
+else
+    NFAIL=$(printf '%s\n' "$DPOWSAN_OUT" | grep -c 'dpowsan: FAIL')
+    if [ "$NFAIL" -gt 0 ]; then
+        echo "DPOWSAN=${NFAIL} failures seeds=${SAN_SEEDS}"
+        printf '%s\n' "$DPOWSAN_OUT" | grep 'dpowsan: FAIL'
+    else
+        # nonzero exit with zero scenario failures = the sanitizer itself
+        # broke (crash/timeout); never report that as near-clean
+        echo "DPOWSAN=error (rc=$sanrc)"
+    fi
+fi
 exit "$rc"

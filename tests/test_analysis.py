@@ -13,7 +13,18 @@ from pathlib import Path
 
 import pytest
 
-from tpu_dpow.analysis import CHECKERS, blocking, clock, flags, locks, metrics, tasks, topics
+from tpu_dpow.analysis import (
+    CHECKERS,
+    blocking,
+    clock,
+    concurrency,
+    flags,
+    locks,
+    metrics,
+    sanitizer,
+    tasks,
+    topics,
+)
 from tpu_dpow.analysis.core import Baseline, Finding, Project, run_all
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
@@ -651,6 +662,293 @@ def test_flag_drift_missing_doc_is_a_finding(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DPOW801 await-interference
+# ---------------------------------------------------------------------------
+
+
+def test_interference_fires_on_check_await_act(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/bad.py": (
+                "class Hub:\n"
+                "    def __init__(self):\n"
+                "        self.requests = {}\n\n"
+                "    async def install(self, key, store):\n"
+                "        if key in self.requests:\n"
+                "            return None\n"
+                "        await store.set(key, 'pending')\n"
+                "        self.requests[key] = object()\n"
+                "        return key\n"
+            )
+        },
+    )
+    found = concurrency.check_interference(project)
+    assert len(found) == 1 and found[0].code == "DPOW801"
+    assert found[0].line == 9  # the write, not the guard
+
+
+def test_interference_quiet_on_recheck_lock_and_sibling_branch(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/good.py": (
+                "import asyncio\n\n"
+                "class Hub:\n"
+                "    def __init__(self):\n"
+                "        self.requests = {}\n"
+                "        self._lock = asyncio.Lock()\n\n"
+                "    async def recheck(self, key, store):\n"
+                "        if key in self.requests:\n"
+                "            return\n"
+                "        await store.set(key, 'p')\n"
+                "        if key in self.requests:\n"
+                "            return\n"
+                "        self.requests[key] = object()\n\n"
+                "    async def locked(self, key, store):\n"
+                "        async with self._lock:\n"
+                "            if key in self.requests:\n"
+                "                return\n"
+                "            await store.set(key, 'p')\n"
+                "            self.requests[key] = object()\n\n"
+                "    async def sibling(self, key, store):\n"
+                "        if key in self.requests:\n"
+                "            del self.requests[key]\n"
+                "        else:\n"
+                "            await store.set(key, 'p')\n"
+            )
+        },
+    )
+    assert concurrency.check_interference(project) == []
+
+
+def test_interference_resolves_helper_writes_one_level(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/helper.py": (
+                "class Hub:\n"
+                "    def __init__(self):\n"
+                "        self.requests = {}\n\n"
+                "    async def teardown(self, key, store):\n"
+                "        if key in self.requests:\n"
+                "            await store.delete(key)\n"
+                "            self._drop(key)\n\n"
+                "    async def teardown_guarded(self, key, store):\n"
+                "        if key in self.requests:\n"
+                "            await store.delete(key)\n"
+                "            self._drop_checked(key)\n\n"
+                "    def _drop(self, key):\n"
+                "        self.requests.pop(key, None)\n\n"
+                "    def _drop_checked(self, key):\n"
+                "        if key in self.requests:\n"
+                "            self.requests.pop(key, None)\n"
+            )
+        },
+    )
+    found = concurrency.check_interference(project)
+    # the blind helper fires at its call site; the re-checking one is clean
+    assert [f.line for f in found] == [8]
+    assert found[0].code == "DPOW801"
+
+
+def test_interference_pins_the_registry_capacity_fix_shape(tmp_path):
+    """The ISSUE 8 acceptance property: the PRE-fix shape of the fleet
+    registry's capacity check (len guard, suspending evict, unconditional
+    insert) fires DPOW801, and the shipped post-fix shape (re-validating
+    while loop) is clean — deleting the fix re-fires the checker."""
+    prefix = (
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self.workers = {}\n"
+        "        self.limit = 4\n\n"
+        "    async def announce(self, wid, store):\n"
+        "        if len(self.workers) >= self.limit:\n"
+        "            if not await self._evict(store):\n"
+        "                return None\n"
+        "        self.workers[wid] = object()\n"
+        "        return wid\n\n"
+        "    async def _evict(self, store):\n"
+        "        victim = next(iter(self.workers), None)\n"
+        "        if victim is None:\n"
+        "            return False\n"
+        "        self.workers.pop(victim, None)\n"
+        "        await store.delete(victim)\n"
+        "        return True\n"
+    )
+    postfix = prefix.replace(
+        "        if len(self.workers) >= self.limit:\n"
+        "            if not await self._evict(store):\n"
+        "                return None\n",
+        "        while wid not in self.workers and (\n"
+        "            len(self.workers) >= self.limit\n"
+        "        ):\n"
+        "            if not await self._evict(store):\n"
+        "                return None\n",
+    )
+    assert postfix != prefix
+    fired = concurrency.check_interference(
+        make_project(tmp_path / "pre", {"tpu_dpow/registry.py": prefix})
+    )
+    assert any(
+        f.code == "DPOW801" and f.line == 10 for f in fired
+    ), fired
+    assert (
+        concurrency.check_interference(
+            make_project(tmp_path / "post", {"tpu_dpow/registry.py": postfix})
+        )
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# DPOW802 lock-order
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_fires_on_cycle_and_reentry(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/locks_bad.py": (
+                "import asyncio\n\n"
+                "lock_a = asyncio.Lock()\n"
+                "lock_b = asyncio.Lock()\n\n"
+                "async def ab():\n"
+                "    async with lock_a:\n"
+                "        async with lock_b:\n"
+                "            pass\n\n"
+                "async def ba():\n"
+                "    async with lock_b:\n"
+                "        async with lock_a:\n"
+                "            pass\n\n"
+                "class Box:\n"
+                "    def __init__(self):\n"
+                "        self._lock = asyncio.Lock()\n\n"
+                "    async def reenter(self):\n"
+                "        async with self._lock:\n"
+                "            async with self._lock:\n"
+                "                pass\n"
+            )
+        },
+    )
+    found = concurrency.check_lock_order(project)
+    assert codes(found) == ["DPOW802"]
+    msgs = " | ".join(f.message for f in found)
+    assert "reentrant" in msgs and "cycle" in msgs
+
+
+def test_lock_order_quiet_on_consistent_order(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/locks_good.py": (
+                "import asyncio\n\n"
+                "lock_a = asyncio.Lock()\n"
+                "lock_b = asyncio.Lock()\n\n"
+                "async def one():\n"
+                "    async with lock_a:\n"
+                "        async with lock_b:\n"
+                "            pass\n\n"
+                "async def two():\n"
+                "    async with lock_a, lock_b:\n"
+                "        pass\n"
+            )
+        },
+    )
+    assert concurrency.check_lock_order(project) == []
+
+
+# ---------------------------------------------------------------------------
+# DPOW803 untrusted-input flow
+# ---------------------------------------------------------------------------
+
+
+def test_taint_fires_on_raw_payload_to_struct_and_store(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/taint_bad.py": (
+                "import struct\n\n"
+                "class Handler:\n"
+                "    async def on_work(self, topic, payload):\n"
+                "        raw = payload[1:]\n"
+                "        nonce = struct.unpack('<Q', raw.encode('latin-1'))\n"
+                "        await self.store.set(payload, 'x')\n"
+                "        return nonce\n"
+            )
+        },
+    )
+    found = concurrency.check_taint(project)
+    assert len(found) == 2 and codes(found) == ["DPOW803"]
+    sinks = " | ".join(f.message for f in found)
+    assert "struct.unpack" in sinks and "store.set" in sinks
+
+
+def test_taint_quiet_after_decode_boundary_and_in_boundary_module(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/taint_good.py": (
+                "import struct\n"
+                "from tpu_dpow.transport import wire\n\n"
+                "class Handler:\n"
+                "    async def on_result(self, topic, payload):\n"
+                "        block_hash, work, client, tid = "
+                "wire.decode_result_any(payload)\n"
+                "        await self.store.set(block_hash, work)\n"
+                "        return struct.unpack('<Q', work)\n"
+            ),
+            # the decoder module IS the boundary: raw unpacks are its job
+            "tpu_dpow/transport/wire.py": (
+                "import struct\n\n"
+                "def decode_work_frame(payload):\n"
+                "    return struct.unpack('<Q', payload)\n"
+            ),
+        },
+    )
+    assert concurrency.check_taint(project) == []
+
+
+# ---------------------------------------------------------------------------
+# dpowsan: the schedule-perturbing confirmer
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_same_seed_same_interleaving_trace():
+    """Reproducibility contract: the seed drives every perturbation
+    decision, so one seed is one interleaving — a failure report's
+    `--san_seeds 1 --san_base_seed K` replay is exact."""
+    a = sanitizer.run_seed("coalesce", 5)
+    b = sanitizer.run_seed("coalesce", 5)
+    assert a.ok, a.error
+    assert b.ok and a.trace_digest == b.trace_digest
+    c = sanitizer.run_seed("coalesce", 6)
+    assert c.ok and c.trace_digest != a.trace_digest
+
+
+def test_sanitizer_annotates_static_findings():
+    f_hit = Finding("tpu_dpow/server/app.py", 10, "DPOW801", "m1")
+    f_hot = Finding("tpu_dpow/sched/window.py", 20, "DPOW801", "m2")
+    f_cold = Finding("tpu_dpow/client/app.py", 30, "DPOW801", "m3")
+    f_other = Finding("tpu_dpow/server/app.py", 40, "DPOW802", "m4")
+    report = sanitizer.SanitizerReport(
+        runs=[
+            sanitizer.SeedRun(
+                "coalesce", 0, False, "d",
+                error="boom", tb_paths=("tpu_dpow/server/app.py",),
+            ),
+            sanitizer.SeedRun("coalesce", 1, True, "e"),
+        ]
+    )
+    verdicts = sanitizer.annotate([f_hit, f_hot, f_cold, f_other], report)
+    assert verdicts[f_hit.key()] == sanitizer.CONFIRMED
+    assert verdicts[f_hot.key()] == sanitizer.NOT_REPRODUCED
+    assert verdicts[f_cold.key()] == sanitizer.UNEXERCISED
+    assert f_other.key() not in verdicts  # only the 801 race class
+
+
+# ---------------------------------------------------------------------------
 # waivers + baseline
 # ---------------------------------------------------------------------------
 
@@ -723,7 +1021,16 @@ def test_repo_is_clean_against_committed_baseline():
     )
 
 
-@pytest.mark.parametrize("args,rc", [(["--list"], 0), ([], 0)])
+@pytest.mark.parametrize(
+    "args,rc",
+    [
+        (["--list"], 0),
+        ([], 0),
+        # one seed per scenario: the repo's state machines survive a
+        # perturbed replay, and the CLI plumbs the san flags through
+        (["--san", "--san_seeds", "1"], 0),
+    ],
+)
 def test_cli_entrypoint(args, rc):
     proc = subprocess.run(
         [sys.executable, "-m", "tpu_dpow.analysis", *args],
@@ -733,3 +1040,9 @@ def test_cli_entrypoint(args, rc):
         timeout=120,
     )
     assert proc.returncode == rc, proc.stdout + proc.stderr
+    if "--list" in args:
+        # the catalogue names every shipped family, 8xx included
+        for code in ("DPOW101", "DPOW801", "DPOW802", "DPOW803"):
+            assert code in proc.stdout
+    if "--san" in args:
+        assert "dpowsan: clean" in proc.stderr, proc.stderr

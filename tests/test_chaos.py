@@ -709,3 +709,289 @@ def test_chaos_coalesced_waiters_winner_races_one_cancel():
             await server.close()
 
     run(main())
+
+
+async def _bounded_window_server():
+    """DpowServer with ONE admission slot — the configuration where a
+    same-hash dispatcher deterministically parks in the admission queue
+    behind an unrelated blocker dispatch (the promote-window race setup
+    dpowsan's bounded coalesce seeds explore)."""
+    obs.reset()
+    clock = FakeClock()
+    broker = Broker()
+    config = ServerConfig(
+        base_difficulty=EASY, throttle=1000.0, heartbeat_interval=3600.0,
+        statistics_interval=3600.0, work_republish_interval=2.0,
+        fleet=False, max_inflight_dispatches=1,
+    )
+    store = MemoryStore()
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"),
+        clock=clock,
+    )
+    await server.setup()
+    server.start_loops()
+    await store.hset("service:svc", {"api_key": hash_key("secret"),
+                                     "public": "N", "precache": "0",
+                                     "ondemand": "0"})
+    await store.sadd("services", "svc")
+    return server
+
+
+def _assert_dispatch_tables_empty(server, h):
+    assert server.work_futures == {}
+    assert server._future_waiters == {}
+    assert server._dispatch_gates == {}
+    assert server._dispatch_tickets == {}
+    assert server._difficulty_locks == {}
+    assert not server.supervisor.tracked(h)
+
+
+def test_chaos_promote_window_race_gated_waiter_serves_from_store():
+    """dpowsan regression (ISSUE 8, DPOW801 class): a gated waiter whose
+    dispatcher dies while QUEUED for admission must answer from the STORE
+    when the hash resolved in that window. Pre-fix it promoted into a void
+    re-dispatch of the solved hash — every later worker result is dropped
+    at the not-WORK_PENDING check, so nothing could ever resolve it and
+    the waiter stranded to its deadline. Deleting the store re-check in
+    _dispatch_ondemand's gated path re-strands this exact choreography."""
+
+    async def main():
+        server = await _bounded_window_server()
+        try:
+            blocker_h, h = random_hash(), random_hash()
+            # the single window slot is taken by an unrelated dispatch
+            blocker = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": blocker_h,
+                 "timeout": 25}))
+            await settle()
+            assert blocker_h in server.work_futures
+            # the dispatcher for h parks in the admission queue — gate
+            # installed, dispatch NOT yet created
+            dispatcher = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 25}))
+            await settle()
+            assert h in server._dispatch_gates
+            assert h not in server.work_futures
+            # a third request coalesces behind the queued dispatcher's gate
+            waiter = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 25}))
+            await settle()
+            # the answer lands while both are parked (work for this hash
+            # was already in flight: the dispatcher's entry write made the
+            # store accept results), then the dispatcher dies in the queue
+            work = solve(h, EASY)
+            await server.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, PAYOUT_1))
+            dispatcher.cancel()
+            # the gated waiter must serve PROMPTLY from the store, not
+            # promote into a re-dispatch stuck behind the blocker
+            assert await asyncio.wait_for(waiter, timeout=10) == {
+                "work": work, "hash": h}
+            with pytest.raises(asyncio.CancelledError):
+                await dispatcher
+            # the blocker is untouched by any of this
+            blocker_work = solve(blocker_h, EASY)
+            await server.client_result_handler(
+                "result/ondemand",
+                encode_result_payload(blocker_h, blocker_work, PAYOUT_2))
+            assert await blocker == {"work": blocker_work, "hash": blocker_h}
+            await settle()
+            _assert_dispatch_tables_empty(server, h)
+        finally:
+            await server.close()
+
+    run(main())
+
+
+def test_chaos_queued_dispatcher_serves_from_store_after_grant():
+    """dpowsan regression (ISSUE 8, DPOW801 class), the dispatcher's own
+    face of the promote-window race: a dispatcher GRANTED admission after
+    its hash resolved mid-queue must answer from the store. Pre-fix it
+    published a dispatch for the solved hash whose every result the
+    handler drops as stale, stranding it to the deadline. Deleting the
+    queued-path store re-check in _dispatch_ondemand re-strands this."""
+
+    async def main():
+        server = await _bounded_window_server()
+        try:
+            blocker_h, h = random_hash(), random_hash()
+            blocker = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": blocker_h,
+                 "timeout": 25}))
+            await settle()
+            assert blocker_h in server.work_futures
+            dispatcher = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 25}))
+            await settle()
+            assert h in server._dispatch_gates
+            assert h not in server.work_futures
+            # the answer for h lands while the dispatcher queues...
+            work = solve(h, EASY)
+            await server.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, PAYOUT_1))
+            # ...then the blocker completes, freeing the slot: the grant
+            # reaches the queued dispatcher
+            blocker_work = solve(blocker_h, EASY)
+            await server.client_result_handler(
+                "result/ondemand",
+                encode_result_payload(blocker_h, blocker_work, PAYOUT_2))
+            assert await blocker == {"work": blocker_work, "hash": blocker_h}
+            await settle()
+            # the granted dispatcher must hand its slot back and serve the
+            # stored work: installing a dispatch here publishes a solved
+            # hash nothing can ever resolve
+            assert h not in server.work_futures
+            assert await asyncio.wait_for(dispatcher, timeout=5) == {
+                "work": work, "hash": h}
+            await settle()
+            _assert_dispatch_tables_empty(server, h)
+        finally:
+            await server.close()
+
+    run(main())
+
+
+def test_chaos_cancel_during_queue_recheck_releases_window_slot():
+    """code-review regression (ISSUE 8): the queued-path store re-check
+    awaits while the admission ticket is granted but not yet transferred
+    to the dispatch state; a request cancelled exactly there must hand
+    its window slot back — with a bounded window, every leaked slot
+    shrinks dispatch capacity forever."""
+
+    async def main():
+        server = await _bounded_window_server()
+        try:
+            blocker_h, h, h2 = random_hash(), random_hash(), random_hash()
+            blocker = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": blocker_h,
+                 "timeout": 25}))
+            await settle()
+            assert blocker_h in server.work_futures
+            dispatcher = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 25}))
+            await settle()
+            assert h in server._dispatch_gates
+            assert h not in server.work_futures
+            # Arm a hang on the NEXT store read of block:h — which is the
+            # queued dispatcher's post-grant re-check (its entry read
+            # already happened).
+            orig_get = server.store.get
+            entered, hang = asyncio.Event(), asyncio.Event()
+
+            async def hanging_get(key):
+                if key == f"block:{h}":
+                    entered.set()
+                    await hang.wait()
+                return await orig_get(key)
+
+            server.store.get = hanging_get
+            try:
+                # free the slot: the grant reaches the queued dispatcher,
+                # which parks inside the armed re-check holding the ticket
+                blocker_work = solve(blocker_h, EASY)
+                await server.client_result_handler(
+                    "result/ondemand",
+                    encode_result_payload(blocker_h, blocker_work, PAYOUT_2))
+                assert await blocker == {
+                    "work": blocker_work, "hash": blocker_h}
+                await asyncio.wait_for(entered.wait(), timeout=5)
+                dispatcher.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await dispatcher
+            finally:
+                server.store.get = orig_get
+                hang.set()
+            # the slot must be free again: a fresh dispatch proceeds
+            # instead of queueing behind a leaked ticket forever
+            req2 = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h2,
+                 "timeout": 25}))
+            await settle()
+            assert h2 in server.work_futures
+            w2 = solve(h2, EASY)
+            await server.client_result_handler(
+                "result/ondemand", encode_result_payload(h2, w2, PAYOUT_1))
+            assert await asyncio.wait_for(req2, timeout=5) == {
+                "work": w2, "hash": h2}
+            await settle()
+            _assert_dispatch_tables_empty(server, h2)
+        finally:
+            await server.close()
+
+    run(main())
+
+
+def test_chaos_gated_waiter_with_raised_difficulty_redispatches_weak_solved():
+    """code-review regression (ISSUE 8): the promote-window store answer
+    must be strong enough for THIS waiter. A base-difficulty result
+    landing in the window satisfies a base waiter, but a raised-
+    difficulty waiter served that work would only bounce off final
+    validation as RetryRequest — it must instead reset the frontier and
+    re-dispatch at its own target."""
+
+    def solve_weak(block_hash, base, raised):
+        # first nonce meeting base but NOT raised — the work a base
+        # dispatch legitimately produces
+        w = 0
+        while True:
+            work = f"{w:016x}"
+            if base <= nc.work_value(block_hash, work) < raised:
+                return work
+            w += 1
+
+    async def main():
+        server = await _bounded_window_server()
+        raised = nc.derive_work_difficulty(4.0, EASY)
+        try:
+            blocker_h, h = random_hash(), random_hash()
+            blocker = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": blocker_h,
+                 "timeout": 25}))
+            await settle()
+            assert blocker_h in server.work_futures
+            dispatcher = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 25}))
+            await settle()
+            assert h in server._dispatch_gates
+            waiter = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 25, "multiplier": 4.0}))
+            await settle()
+            # a BASE-strength result lands in the window, then the base
+            # dispatcher dies queued: only the raised waiter remains
+            weak = solve_weak(h, EASY, raised)
+            await server.client_result_handler(
+                "result/ondemand", encode_result_payload(h, weak, PAYOUT_1))
+            dispatcher.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await dispatcher
+            await settle()
+            # the waiter saw weak work, reset the frontier, and is now
+            # queued to re-dispatch behind the blocker; release the slot
+            blocker_work = solve(blocker_h, EASY)
+            await server.client_result_handler(
+                "result/ondemand",
+                encode_result_payload(blocker_h, blocker_work, PAYOUT_2))
+            assert await blocker == {"work": blocker_work, "hash": blocker_h}
+            await settle()
+            # re-dispatched at the WAITER's difficulty, not served weak
+            assert h in server.work_futures
+            assert await server.store.get(
+                f"block-difficulty:{h}") == f"{raised:016x}"
+            strong = solve(h, raised)
+            await server.client_result_handler(
+                "result/ondemand", encode_result_payload(h, strong, PAYOUT_1))
+            assert await asyncio.wait_for(waiter, timeout=10) == {
+                "work": strong, "hash": h}
+            await settle()
+            _assert_dispatch_tables_empty(server, h)
+        finally:
+            await server.close()
+
+    run(main())

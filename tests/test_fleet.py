@@ -177,6 +177,53 @@ def test_registry_cardinality_bound_evicts_stale_then_refuses():
     run(main())
 
 
+def test_announce_capacity_race_holds_bound():
+    """dpowsan regression (ISSUE 8, DPOW801): the capacity check-then-insert
+    in handle_announce suspends on the store while evicting, and a second
+    fresh announce can land in that gap. Pre-fix both announces passed one
+    len() check and the MAX_WORKERS bound overshot; the re-validating loop
+    must hold the bound whatever the interleaving."""
+
+    class YieldingStore:
+        """MemoryStore whose ops actually suspend — without a real await
+        point the two announces would never interleave."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if not asyncio.iscoroutinefunction(attr):
+                return attr
+
+            async def op(*args, **kwargs):
+                await asyncio.sleep(0)
+                return await attr(*args, **kwargs)
+
+            return op
+
+    async def main():
+        clock = FakeClock()
+        reg = WorkerRegistry(YieldingStore(MemoryStore()), clock=clock,
+                             ttl=10.0, max_workers=2)
+        await reg.handle_announce(_announce("old1"))
+        await reg.handle_announce(_announce("old2"))
+        await clock.advance(11.0)  # both records stale: evictable
+        # two fresh ids announce CONCURRENTLY into the full registry: the
+        # first parks on the eviction's store delete, the second runs
+        results = await asyncio.gather(
+            reg.handle_announce(_announce("newA")),
+            reg.handle_announce(_announce("newB")),
+        )
+        assert len(reg._workers) <= reg.max_workers, reg._workers.keys()
+        # both were admitted — each eviction freed a genuinely stale slot
+        assert [r.worker_id for r in results if r is not None] == [
+            "newA", "newB"]
+        assert reg.get("newA") is not None and reg.get("newB") is not None
+
+    run(main())
+
+
 def test_registry_ema_and_restart_persistence():
     async def main():
         clock = FakeClock()

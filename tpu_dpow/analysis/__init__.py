@@ -22,7 +22,7 @@ waiver syntax: docs/analysis.md.
 """
 
 from .core import Baseline, Finding, Project, run_all  # noqa: F401
-from . import blocking, clock, flags, locks, metrics, tasks, topics  # noqa: F401
+from . import blocking, clock, concurrency, flags, locks, metrics, tasks, topics  # noqa: F401
 
 #: checker registry, in catalogue order (docs/analysis.md)
 CHECKERS = (
@@ -33,4 +33,5 @@ CHECKERS = (
     metrics.check,
     topics.check,
     flags.check,
+    concurrency.check,
 )

@@ -1,16 +1,22 @@
-"""CLI: ``python -m tpu_dpow.analysis [--root DIR] [--write-baseline]``.
+"""CLI: ``python -m tpu_dpow.analysis [--root DIR] [--write-baseline] [--san]``.
 
 Exit 0 when every finding is inline-waived or baselined, 1 otherwise.
-Output format (one per line): ``path:line  CODE  message``.
+Output format (one per line): ``path:line  CODE  message``. ``--san``
+additionally replays the sanitizer scenarios (analysis/sanitizer.py)
+under ``--san_seeds`` seeded interleavings and fails on any scenario
+invariant breach. The run prints its own wall time: the whole static
+pass must stay cheap enough to sit in every lint invocation (one parsed
+AST per file, shared across all checker families — core.SourceFile).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import time
 from pathlib import Path
 
-from . import CHECKERS
+from . import CHECKERS, sanitizer
 from .core import DEFAULT_BASELINE, Baseline, Project, run_all
 
 _CATALOGUE = """\
@@ -31,17 +37,23 @@ DPOW606  payload-grammar     spec binary-frame row no code declares
 DPOW701  flag-drift          config flag missing from docs/flags.md
 DPOW702  flag-drift          documented flag no config declares
 DPOW703  flag-drift          documented default != declared default
+DPOW801  await-interference  shared state checked, then mutated after an await
+DPOW802  lock-order          acquisition cycles / reentrant lock acquisition
+DPOW803  untrusted-input     raw transport payload consumed before the decode boundary
 
 Waive inline with `# dpowlint: disable=CODE — justification` (applies to
 that line and the next); park intentional debt in the baseline file.
-Details: docs/analysis.md."""
+The DPOW801 family has a runtime confirmer: --san replays the coalescing
+and fleet re-cover scenarios under seeded interleaving perturbation
+(--san_seeds N, env DPOW_SAN_SEEDS). Details: docs/analysis.md."""
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         "python -m tpu_dpow.analysis",
         description="dpowlint: AST-based invariant checkers for the "
-        "async/Clock/metrics/topic/flag contracts (docs/analysis.md)",
+        "async/Clock/metrics/topic/flag/concurrency contracts "
+        "(docs/analysis.md), plus the dpowsan interleaving sanitizer",
     )
     parser.add_argument(
         "--root",
@@ -66,18 +78,21 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list", action="store_true", help="print the checker catalogue"
     )
+    sanitizer.add_flags(parser)
     args = parser.parse_args(argv)
 
     if args.list:
         print(_CATALOGUE)
         return 0
 
+    t0 = time.perf_counter()
     root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
     baseline_path = (
         Path(args.baseline) if args.baseline else Path(__file__).parent / DEFAULT_BASELINE
     )
     project = Project(root)
     findings = run_all(project, CHECKERS)
+    static_elapsed = time.perf_counter() - t0
 
     if args.write_baseline:
         Baseline().save(baseline_path, findings)
@@ -92,19 +107,40 @@ def main(argv=None) -> int:
     for f in fresh:
         print(f.render())
     baselined = len(findings) - len(fresh)
+    rc = 0
     if fresh:
         print(
             f"dpowlint: {len(fresh)} finding(s)"
-            + (f" ({baselined} baselined)" if baselined else ""),
+            + (f" ({baselined} baselined)" if baselined else "")
+            + f" in {static_elapsed:.2f}s",
             file=sys.stderr,
         )
-        return 1
-    print(
-        "dpowlint: clean"
-        + (f" ({baselined} baselined finding(s) remain)" if baselined else ""),
-        file=sys.stderr,
-    )
-    return 0
+        rc = 1
+    else:
+        print(
+            "dpowlint: clean"
+            + (f" ({baselined} baselined finding(s) remain)" if baselined else "")
+            + f" in {static_elapsed:.2f}s",
+            file=sys.stderr,
+        )
+
+    if args.san:
+        t1 = time.perf_counter()
+        report = sanitizer.run_seeds(args.san_seeds, args.san_base_seed)
+        print(report.render(), file=sys.stderr)
+        verdicts = sanitizer.annotate(fresh, report)
+        for f in fresh:
+            verdict = verdicts.get(f.key())
+            if verdict is not None:
+                print(f"dpowsan: {verdict}  {f.render()}", file=sys.stderr)
+        print(
+            f"dpowsan: {len(report.runs)} runs in "
+            f"{time.perf_counter() - t1:.2f}s",
+            file=sys.stderr,
+        )
+        if report.failures:
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

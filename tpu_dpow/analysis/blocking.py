@@ -74,8 +74,8 @@ def _calls_outside_nested_sync_defs(fn: ast.AsyncFunctionDef) -> List[ast.Call]:
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for src in project.sources():
-        aliases = import_aliases(src.tree)
-        for node in ast.walk(src.tree):
+        aliases = src.aliases
+        for node in src.nodes():
             if not isinstance(node, ast.AsyncFunctionDef):
                 continue
             for call in _calls_outside_nested_sync_defs(node):

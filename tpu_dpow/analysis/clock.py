@@ -54,8 +54,8 @@ def check(project: Project) -> List[Finding]:
     for src in project.sources():
         if any(src.rel.startswith(p) for p in ALLOWLIST):
             continue
-        aliases = import_aliases(src.tree)
-        for node in ast.walk(src.tree):
+        aliases = src.aliases
+        for node in src.nodes():
             if not isinstance(node, ast.Call):
                 continue
             target = resolve_call(node, aliases)

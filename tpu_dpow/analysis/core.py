@@ -39,13 +39,22 @@ class Finding:
 
 
 class SourceFile:
-    """One parsed .py file: AST + the waiver comments tokenize found."""
+    """One parsed .py file: AST + the waiver comments tokenize found.
+
+    The AST is parsed exactly once and shared by every checker; the two
+    traversals every checker family needs — the flat node list and the
+    import-alias map — are computed lazily and cached here too, so a run
+    of 10+ checker families costs one parse and one full walk per file,
+    not one per (file, checker) pair.
+    """
 
     def __init__(self, path: Path, rel: str):
         self.path = path
         self.rel = rel
         self.text = path.read_text(encoding="utf-8")
         self.tree = ast.parse(self.text, filename=rel)
+        self._nodes: Optional[List[ast.AST]] = None
+        self._aliases: Optional[Dict[str, str]] = None
         self.waivers: Dict[int, Set[str]] = {}
         try:
             tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
@@ -58,6 +67,20 @@ class SourceFile:
                     self.waivers.setdefault(tok.start[0], set()).update(codes)
         except tokenize.TokenError:
             pass
+
+    def nodes(self) -> List[ast.AST]:
+        """Every node of the tree, walk order, computed once. Checkers that
+        scan for a node type iterate this instead of re-walking the AST."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    @property
+    def aliases(self) -> Dict[str, str]:
+        """``import_aliases(self.tree)``, computed once per file."""
+        if self._aliases is None:
+            self._aliases = import_aliases(self.tree, self.nodes())
+        return self._aliases
 
     def waived(self, code: str, line: int) -> bool:
         for ln in (line, line - 1):
@@ -81,11 +104,11 @@ def _module_constants(tree: ast.Module) -> Dict[str, str]:
     return out
 
 
-def import_aliases(tree: ast.Module) -> Dict[str, str]:
+def import_aliases(tree, nodes: Optional[Iterable[ast.AST]] = None) -> Dict[str, str]:
     """Map local names to dotted origins: ``import time as t`` → t: time;
     ``from asyncio import sleep`` → sleep: asyncio.sleep."""
     aliases: Dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in nodes if nodes is not None else ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 aliases[a.asname or a.name.split(".")[0]] = (

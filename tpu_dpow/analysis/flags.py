@@ -30,6 +30,10 @@ FLAGS_DOC = "flags.md"
 CONFIGS: Tuple[Tuple[str, str], ...] = (
     ("server", "server/config.py"),
     ("client", "client/config.py"),
+    # the dpowsan CLI surface (analysis/sanitizer.py add_flags); the
+    # analysis package is excluded from the code checkers but its flag
+    # surface is an operator contract like any other
+    ("sanitizer", "analysis/sanitizer.py"),
 )
 
 _MISSING = object()
@@ -99,8 +103,15 @@ def _dataclass_defaults(tree: ast.Module) -> Dict[str, object]:
 
 
 def config_flags(project: Project, config_rel: str) -> List[FlagInfo]:
+    # include_excluded: the sanitizer's flag surface lives under analysis/,
+    # which the code checkers skip — the flag contract must not.
     src = next(
-        (s for s in project.sources() if s.rel.endswith(config_rel)), None
+        (
+            s
+            for s in project.sources(include_excluded=True)
+            if s.rel.endswith(config_rel)
+        ),
+        None,
     )
     if src is None:
         return []

@@ -53,7 +53,7 @@ def _awaits_inside(body) -> List[ast.AST]:
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for src in project.sources():
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if not isinstance(node, ast.With):
                 continue
             held = [

@@ -83,7 +83,7 @@ def code_sites(project: Project) -> List[MetricSite]:
     sites: List[MetricSite] = []
     for src in project.sources():
         consts = project.constants(src)
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)

@@ -1,0 +1,622 @@
+"""dpowsan: a schedule-perturbing confirmer for the DPOW801 race class.
+
+The static half (analysis/concurrency.py) names check-then-act *candidates*;
+this module tries to make the real state machines actually fail. It wraps
+the chaos harness's FakeClock/in-proc stack with a seeded interleaving
+perturber and replays the two e2e scenarios whose interleavings bit us in
+review — same-hash coalescing and fleet straggler re-cover — under N seeds:
+
+  * the :class:`Perturber` injects ``asyncio.sleep(0)`` yields of seeded
+    depth and real task-wakeup REORDERING (parked awaiters released in
+    shuffled order via ``call_soon``) at every store and transport
+    operation — exactly the await points the checker reasons about;
+  * :class:`PerturbingStore` / :class:`PerturbingTransport` wrap the two
+    injectable seams, so the server under test is the real DpowServer with
+    no test-only code paths;
+  * every run is reproducible by seed: the RNG drives every decision, the
+    clock is a FakeClock, and the decision trace digests into a stable id
+    (``same seed → same trace`` is pinned in tests/test_analysis.py).
+
+A scenario PASSES when its end-state invariants hold — every request is
+answered or fails cleanly within its budget, nothing is stranded while the
+store holds valid work, and every per-dispatch side table is torn down.
+A failure names the seed (replay with ``--san_seeds 1 --san_base_seed K``)
+and its traceback; :func:`annotate` folds the runs back onto the static
+DPOW801 findings as confirmed / not-reproduced / unexercised.
+
+Flag surface (machine-checked against docs/flags.md, DPOW701-703):
+``--san`` runs the sanitizer after the static pass, ``--san_seeds`` /
+env ``DPOW_SAN_SEEDS`` sets the replay count, ``--san_base_seed`` / env
+``DPOW_SAN_BASE_SEED`` offsets the seed range for reproduction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import struct
+import sys
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: difficulty used by every scenario: ~256 expected blake2b trials, instant
+#: to brute-force on the host.
+EASY_DIFFICULTY = 0xFF00000000000000
+
+#: package modules the scenarios actually drive — the denominator for
+#: annotate()'s confirmed/not-reproduced/unexercised verdicts.
+INSTRUMENTED_PREFIXES = (
+    "tpu_dpow/server/app.py",
+    "tpu_dpow/fleet/",
+    "tpu_dpow/sched/",
+    "tpu_dpow/store/",
+    "tpu_dpow/resilience/",
+    "tpu_dpow/transport/broker.py",
+    "tpu_dpow/transport/inproc.py",
+)
+
+
+@dataclass
+class SanitizerConfig:
+    """Defaults for the sanitizer flags (docs/flags.md, sanitizer section)."""
+
+    san: bool = False
+    san_seeds: int = 20
+    san_base_seed: int = 0
+
+
+def _env_int(name: str, default: int) -> int:
+    """Tolerant env override: a malformed value must degrade to the coded
+    default with a warning, not crash every ``python -m tpu_dpow.analysis``
+    invocation (add_flags runs before argparse even sees --san)."""
+    raw = os.getenv(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        print(
+            f"dpowsan: ignoring non-integer {name}={raw!r} "
+            f"(using {default})",
+            file=sys.stderr,
+        )
+        return default
+
+
+def add_flags(p: argparse.ArgumentParser) -> None:
+    """The sanitizer's argparse surface (checked by DPOW701-703)."""
+    c = SanitizerConfig()
+    p.add_argument(
+        "--san", action="store_true",
+        help="after the static pass, replay the coalescing and fleet "
+        "re-cover scenarios under the seeded interleaving perturber",
+    )
+    p.add_argument(
+        "--san_seeds", type=int,
+        default=_env_int("DPOW_SAN_SEEDS", c.san_seeds),
+        help="sanitizer replay count: seeds run per scenario "
+        "(env DPOW_SAN_SEEDS overrides the default)",
+    )
+    p.add_argument(
+        "--san_base_seed", type=int,
+        default=_env_int("DPOW_SAN_BASE_SEED", c.san_base_seed),
+        help="first seed of the replay range — reproduce one failing seed "
+        "K with --san_seeds 1 --san_base_seed K "
+        "(env DPOW_SAN_BASE_SEED overrides the default)",
+    )
+
+
+class SanitizerFailure(AssertionError):
+    """A scenario invariant broke under a perturbed interleaving."""
+
+
+# ---------------------------------------------------------------------------
+# the perturber
+# ---------------------------------------------------------------------------
+
+
+class Perturber:
+    """Seeded interleaving chaos at await points.
+
+    ``point()`` is called by the seam wrappers before and after every
+    store/transport operation. Per call the seeded RNG picks one of:
+
+      * pass through (no extra suspension);
+      * yield to the event loop 1-3 times (``asyncio.sleep(0)``) — slides
+        this coroutine behind everything currently runnable;
+      * PARK: suspend on a future released by a ``call_soon`` callback
+        that wakes all parked coroutines in shuffled order — genuine
+        wakeup reordering, the thing FIFO scheduling never exercises.
+
+    Every decision lands in ``trace``; ``digest()`` is the run's stable
+    fingerprint (same seed + same code ⇒ same digest).
+    """
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.trace: List[str] = []
+        self._parked: List[asyncio.Future] = []
+        self._release_scheduled = False
+
+    async def point(self, site: str) -> None:
+        r = self.rng.random()
+        if r < 0.30:
+            self.trace.append(f"{site}=pass")
+            return
+        if r < 0.80:
+            n = self.rng.randint(1, 3)
+            self.trace.append(f"{site}=yield{n}")
+            for _ in range(n):
+                await asyncio.sleep(0)
+            return
+        self.trace.append(f"{site}=park")
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._parked.append(fut)
+        if not self._release_scheduled:
+            self._release_scheduled = True
+            loop.call_soon(self._release)
+        await fut
+
+    def _release(self) -> None:
+        self._release_scheduled = False
+        parked, self._parked = self._parked, []
+        self.rng.shuffle(parked)
+        for fut in parked:
+            if not fut.done():
+                fut.set_result(None)
+
+    def digest(self) -> str:
+        return hashlib.sha256("\n".join(self.trace).encode()).hexdigest()[:16]
+
+
+class PerturbingStore:
+    """Store-protocol proxy: a perturbation point around every async op."""
+
+    def __init__(self, inner, perturber: Perturber):
+        self._inner = inner
+        self._perturber = perturber
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name.startswith("_") or not asyncio.iscoroutinefunction(attr):
+            return attr
+        perturber = self._perturber
+
+        async def op(*args, **kwargs):
+            await perturber.point(f"store.{name}")
+            result = await attr(*args, **kwargs)
+            await perturber.point(f"store.{name}.done")
+            return result
+
+        return op
+
+
+class PerturbingTransport:
+    """Transport proxy: perturbation around publishes and deliveries."""
+
+    def __init__(self, inner, perturber: Perturber):
+        self._inner = inner
+        self._perturber = perturber
+
+    @property
+    def connected(self) -> bool:
+        return self._inner.connected
+
+    async def connect(self) -> None:
+        await self._inner.connect()
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+    async def subscribe(self, pattern: str, qos: int = 0) -> None:
+        await self._inner.subscribe(pattern, qos)
+
+    async def publish(self, topic: str, payload: str, qos: int = 0) -> None:
+        await self._perturber.point("transport.publish")
+        await self._inner.publish(topic, payload, qos)
+        await self._perturber.point("transport.publish.done")
+
+    async def messages(self):
+        async for msg in self._inner.messages():
+            await self._perturber.point("transport.deliver")
+            yield msg
+
+
+# ---------------------------------------------------------------------------
+# scenario plumbing
+# ---------------------------------------------------------------------------
+
+
+def solve(block_hash: str, difficulty: int, start: int = 0) -> str:
+    """Host-side brute force; instant at EASY_DIFFICULTY."""
+    h = bytes.fromhex(block_hash)
+    nonce = start
+    while True:
+        value = int.from_bytes(
+            hashlib.blake2b(
+                struct.pack("<Q", nonce) + h, digest_size=8
+            ).digest(),
+            "little",
+        )
+        if value >= difficulty:
+            return f"{nonce:016x}"
+        nonce += 1
+
+
+def _scenario_hash(seed: int, tag: str) -> str:
+    return hashlib.blake2b(
+        f"dpowsan-{tag}-{seed}".encode(), digest_size=32
+    ).hexdigest().upper()
+
+
+async def _settle(rounds: int = 60) -> None:
+    for _ in range(rounds):
+        await asyncio.sleep(0)
+
+
+def _payout() -> str:
+    from ..utils import nanocrypto as nc
+
+    return nc.encode_account(bytes(range(32)))
+
+
+async def _start_server(perturber: Perturber, **config_overrides):
+    """The real DpowServer on perturbed seams + FakeClock + in-proc broker."""
+    from ..resilience.clock import FakeClock
+    from ..server import DpowServer, ServerConfig, hash_key
+    from ..store import MemoryStore
+    from ..transport.broker import Broker
+    from ..transport.inproc import InProcTransport
+
+    clock = FakeClock()
+    broker = Broker()
+    config = ServerConfig(
+        base_difficulty=EASY_DIFFICULTY,
+        throttle=1000.0,
+        heartbeat_interval=3600.0,
+        statistics_interval=3600.0,
+        work_republish_interval=2.0,
+        **config_overrides,
+    )
+    store = PerturbingStore(MemoryStore(), perturber)
+    transport = PerturbingTransport(
+        InProcTransport(broker, client_id="server"), perturber
+    )
+    server = DpowServer(config, store, transport, clock=clock)
+    await server.setup()
+    server.start_loops()
+    await store.hset(
+        "service:svc",
+        {"api_key": hash_key("secret"), "public": "N",
+         "display": "svc", "website": "", "precache": "0", "ondemand": "0"},
+    )
+    await store.sadd("services", "svc")
+    return server, store, clock
+
+
+def _check_teardown(server) -> None:
+    """Every per-dispatch side table must be empty once the dust settles."""
+    leaks = {
+        "work_futures": server.work_futures,
+        "_future_waiters": server._future_waiters,
+        "_dispatch_gates": server._dispatch_gates,
+        "_dispatch_tickets": server._dispatch_tickets,
+        "_difficulty_locks": server._difficulty_locks,
+        "_dispatched_difficulty": server._dispatched_difficulty,
+    }
+    stuck = {k: dict(v) for k, v in leaks.items() if v}
+    if stuck:
+        raise SanitizerFailure(f"per-dispatch state leaked: {stuck}")
+
+
+# ---------------------------------------------------------------------------
+# scenario: same-hash coalescing under a cancel/winner race
+# ---------------------------------------------------------------------------
+
+
+async def scenario_coalesce(perturber: Perturber) -> None:
+    """Three same-hash requests coalesce onto one dispatch; one waiter is
+    cancelled at a seed-chosen instant while the winning result lands.
+    Some seeds bound the admission window to 1 with a blocker dispatch
+    holding the slot, so the cancel hits a dispatcher QUEUED for admission
+    — the promote-window race that strands gated waiters (the dpowsan
+    finding fixed in server/app.py). Invariants: every request is served
+    or fails CLEANLY, nobody strands while valid work sits in the store,
+    and the last waiter out tears every side table down."""
+    from ..server.exceptions import RequestTimeout, RetryRequest
+    from ..server.app import WORK_PENDING
+    from ..transport.mqtt_codec import encode_result_payload
+
+    bounded = perturber.rng.random() < 0.5
+    server, store, clock = await _start_server(
+        perturber, fleet=False,
+        max_inflight_dispatches=1 if bounded else 0,
+    )
+    payout = _payout()
+    try:
+        h = _scenario_hash(perturber.seed, "coalesce")
+        blocker_h = _scenario_hash(perturber.seed, "coalesce-blocker")
+        watched = {}
+        if bounded:
+            # a different hash occupies the single window slot, so the
+            # same-hash trio's dispatcher parks in the admission queue
+            watched["blocker"] = asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": blocker_h,
+                 "timeout": 25}
+            ))
+            await _settle(perturber.rng.randint(5, 60))
+        request = {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+        reqs = [
+            asyncio.ensure_future(server.service_handler(dict(request)))
+            for _ in range(3)
+        ]
+        # Cancel one request at a seed-varied point of the dispatch state
+        # machine — mid-gate, mid-admission-queue, mid-publish, or as a
+        # plain waiter.
+        for _ in range(perturber.rng.randint(0, 50)):
+            await asyncio.sleep(0)
+        reqs[0].cancel()
+        work = solve(h, EASY_DIFFICULTY)
+        blocker_work = solve(blocker_h, EASY_DIFFICULTY)
+        release_blocker_at = perturber.rng.randint(40, 200)
+        everyone = list(reqs) + list(watched.values())
+        for spin in range(1500):
+            if all(r.done() for r in everyone):
+                break
+            if await store.get(f"block:{h}") == WORK_PENDING:
+                # a dispatch is live: land the worker result for it
+                await server.client_result_handler(
+                    "result/ondemand", encode_result_payload(h, work, payout)
+                )
+            if bounded and spin >= release_blocker_at and (
+                await store.get(f"block:{blocker_h}") == WORK_PENDING
+            ):
+                await server.client_result_handler(
+                    "result/ondemand",
+                    encode_result_payload(blocker_h, blocker_work, payout),
+                )
+            await asyncio.sleep(0)
+        else:
+            stranded = [
+                name for name, r in
+                [(str(i), r) for i, r in enumerate(reqs)] + list(watched.items())
+                if not r.done()
+            ]
+            stored = await store.get(f"block:{h}")
+            raise SanitizerFailure(
+                f"requests {stranded} stranded after the winner landed "
+                f"(store holds {stored!r}) — the dispatch they wait on can "
+                "never resolve"
+            )
+        results = await asyncio.gather(*reqs, return_exceptions=True)
+        served = {"work": work, "hash": h}
+        for i, r in enumerate(results):
+            if r == served:
+                continue
+            if i == 0 and isinstance(r, asyncio.CancelledError):
+                continue  # the raced waiter may abort cleanly
+            if isinstance(r, (RetryRequest, RequestTimeout)):
+                continue  # clean abort: result raced the teardown
+            raise SanitizerFailure(f"request {i} ended wrong: {r!r}")
+        # the blocker is a request too: "everyone served or fails
+        # cleanly" must hold for it, not just the same-hash trio
+        for name, r in zip(
+            watched, await asyncio.gather(
+                *watched.values(), return_exceptions=True
+            )
+        ):
+            if r == {"work": blocker_work, "hash": blocker_h}:
+                continue
+            if isinstance(r, (RetryRequest, RequestTimeout)):
+                continue
+            raise SanitizerFailure(f"request {name} ended wrong: {r!r}")
+        await _settle()
+        _check_teardown(server)
+    finally:
+        await server.close()
+
+
+# ---------------------------------------------------------------------------
+# scenario: fleet straggler re-cover
+# ---------------------------------------------------------------------------
+
+
+async def scenario_fleet_recover(perturber: Perturber) -> None:
+    """A sharded dispatch loses one worker mid-flight; the supervisor's
+    grace window fires under perturbation and the orphaned shard must be
+    re-covered exactly once, the eventual result honored, and every cover/
+    dispatch table torn down."""
+    from .. import obs
+    from ..transport.mqtt_codec import encode_result_payload
+
+    server, store, clock = await _start_server(
+        perturber,
+        fleet=True,
+        fleet_min_workers=2,
+        fleet_worker_ttl=5.0,
+        hedge_after=10,  # the re-cover path, not the hedge, is under test
+    )
+    recovered_counter = obs.get_registry().counter(
+        "dpow_fleet_ranges_recovered_total")
+    recovered_before = recovered_counter.value()
+    try:
+        workers = (("w1", 1.0e6), ("w2", 2.0e6), ("w3", 3.0e6))
+        for wid, rate in workers:
+            await server.fleet.on_announce(
+                json.dumps({"id": wid, "hashrate": rate, "codec": 1})
+            )
+        h = _scenario_hash(perturber.seed, "recover")
+        req = asyncio.ensure_future(server.service_handler(
+            {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+        ))
+        await _settle()
+        if not server.fleet.cover.tracked(h):
+            raise SanitizerFailure(
+                "dispatch did not shard across the announced fleet"
+            )
+        # w3 goes silent; w1/w2 keep announcing while scenario time passes.
+        # ttl 5 + grace 2: by t=8 the supervisor has fired on the silent
+        # dispatch with w3 stale — its shard must move to a live worker.
+        for _ in range(4):
+            await clock.advance(2.0)
+            for wid, rate in workers[:2]:
+                await server.fleet.on_announce(
+                    json.dumps({"id": wid, "hashrate": rate, "codec": 1})
+                )
+            await _settle()
+        recovered = recovered_counter.value() - recovered_before
+        if recovered < 1:
+            raise SanitizerFailure(
+                "w3 went silent past its ttl but no shard was re-covered"
+            )
+        work = solve(h, EASY_DIFFICULTY)
+        await server.client_result_handler(
+            "result/ondemand", encode_result_payload(h, work, _payout())
+        )
+        result = await asyncio.wait_for(req, timeout=30)
+        if result != {"work": work, "hash": h}:
+            raise SanitizerFailure(f"request served wrong: {result!r}")
+        await _settle()
+        _check_teardown(server)
+        if server.fleet.cover.tracked(h):
+            raise SanitizerFailure("cover table leaked past the teardown")
+        if server.supervisor.tracked(h):
+            raise SanitizerFailure("supervisor entry leaked past the teardown")
+    finally:
+        await server.close()
+
+
+SCENARIOS: Dict[str, Callable] = {
+    "coalesce": scenario_coalesce,
+    "fleet_recover": scenario_fleet_recover,
+}
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SeedRun:
+    scenario: str
+    seed: int
+    ok: bool
+    trace_digest: str
+    error: str = ""
+    tb_paths: Tuple[str, ...] = ()
+
+
+@dataclass
+class SanitizerReport:
+    runs: List[SeedRun] = field(default_factory=list)
+
+    @property
+    def failures(self) -> List[SeedRun]:
+        return [r for r in self.runs if not r.ok]
+
+    @property
+    def seeds(self) -> int:
+        return len({r.seed for r in self.runs})
+
+    def render(self) -> str:
+        lines = []
+        per: Dict[str, List[SeedRun]] = {}
+        for r in self.runs:
+            per.setdefault(r.scenario, []).append(r)
+        for name, runs in per.items():
+            ok = sum(1 for r in runs if r.ok)
+            lines.append(
+                f"dpowsan: scenario={name} seeds={len(runs)} ok={ok}"
+            )
+        for r in self.failures:
+            lines.append(
+                f"dpowsan: FAIL scenario={r.scenario} seed={r.seed} "
+                f"trace={r.trace_digest}\n{r.error}"
+            )
+        if self.failures:
+            lines.append(
+                f"dpowsan: {len(self.failures)} failure(s) — reproduce one "
+                "with --san --san_seeds 1 --san_base_seed <seed>"
+            )
+        else:
+            lines.append(
+                f"dpowsan: clean ({len(self.runs)} runs, {self.seeds} seeds "
+                "per scenario)"
+            )
+        return "\n".join(lines)
+
+
+def run_seed(scenario_name: str, seed: int) -> SeedRun:
+    """One reproducible scenario run under one seed."""
+    perturber = Perturber(seed)
+    scenario = SCENARIOS[scenario_name]
+    try:
+        asyncio.run(asyncio.wait_for(scenario(perturber), timeout=120))
+    except Exception as e:
+        tb = traceback.format_exc()
+        paths = tuple(
+            sorted({
+                frame.filename[frame.filename.find("tpu_dpow/"):]
+                for frame in traceback.extract_tb(e.__traceback__)
+                if "tpu_dpow/" in frame.filename
+            })
+        )
+        return SeedRun(
+            scenario_name, seed, False, perturber.digest(),
+            error=tb.strip().splitlines()[-1] + f"\n{tb}", tb_paths=paths,
+        )
+    return SeedRun(scenario_name, seed, True, perturber.digest())
+
+
+def run_seeds(
+    seeds: int,
+    base_seed: int = 0,
+    scenarios: Optional[Sequence[str]] = None,
+) -> SanitizerReport:
+    report = SanitizerReport()
+    for name in scenarios or SCENARIOS:
+        for seed in range(base_seed, base_seed + seeds):
+            report.runs.append(run_seed(name, seed))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# folding runs back onto the static findings
+# ---------------------------------------------------------------------------
+
+CONFIRMED = "confirmed"
+NOT_REPRODUCED = "not-reproduced"
+UNEXERCISED = "unexercised"
+
+
+def annotate(findings, report: SanitizerReport) -> Dict[str, str]:
+    """Finding.key() → confirmed / not-reproduced / unexercised.
+
+    ``confirmed``: a failing run's traceback touches the finding's file.
+    ``not-reproduced``: the finding's module is on the scenarios' hot path
+    and no seed failed there — evidence (not proof) the candidate is
+    benign or already guarded. ``unexercised``: the scenarios never drive
+    that module; the static verdict stands alone.
+    """
+    failing_paths = set()
+    for run in report.failures:
+        failing_paths.update(run.tb_paths)
+    out: Dict[str, str] = {}
+    for finding in findings:
+        if finding.code != "DPOW801":
+            continue
+        if finding.path in failing_paths:
+            out[finding.key()] = CONFIRMED
+        elif any(finding.path.startswith(p) for p in INSTRUMENTED_PREFIXES):
+            out[finding.key()] = NOT_REPRODUCED
+        else:
+            out[finding.key()] = UNEXERCISED
+    return out

@@ -36,8 +36,8 @@ def _is_spawner(node: ast.Call, aliases) -> bool:
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for src in project.sources():
-        aliases = import_aliases(src.tree)
-        for node in ast.walk(src.tree):
+        aliases = src.aliases
+        for node in src.nodes():
             if (
                 isinstance(node, ast.Expr)
                 and isinstance(node.value, ast.Call)

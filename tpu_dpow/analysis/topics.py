@@ -119,7 +119,7 @@ def code_uses(project: Project) -> List[TopicUse]:
     for src in project.sources():
         consts = project.constants(src)
         explicit_args = set()
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
@@ -132,7 +132,7 @@ def code_uses(project: Project) -> List[TopicUse]:
                     uses.append(
                         TopicUse(topic, node.func.attr, src.rel, node.lineno)
                     )
-        for node in ast.walk(src.tree):
+        for node in src.nodes():
             if isinstance(node, ast.JoinedStr) and id(node) not in explicit_args:
                 topic = _fstring_topic(node)
                 if topic is not None:
@@ -255,7 +255,7 @@ def default_users_acls(project: Project) -> Optional[Dict[str, Dict[str, Tuple[s
     if src is None:
         return None
     out: Dict[str, Dict[str, Tuple[str, ...]]] = {}
-    for node in ast.walk(src.tree):
+    for node in src.nodes():
         if not isinstance(node, ast.Dict):
             continue
         for key, value in zip(node.keys, node.values):

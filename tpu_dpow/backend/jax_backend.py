@@ -580,26 +580,28 @@ class JaxWorkBackend(WorkBackend):
 
     async def close(self) -> None:
         self._closed = True
-        if self._warm_task is not None:
-            self._warm_task.cancel()
+        # Detach-then-await (dpowlint DPOW801): a concurrent close() must
+        # find the slots already empty, not await the same task twice.
+        warm_task, self._warm_task = self._warm_task, None
+        if warm_task is not None:
+            warm_task.cancel()
             try:
-                await self._warm_task
+                await warm_task
             except asyncio.CancelledError:
                 pass
-            self._warm_task = None
         for job in list(self._jobs.values()):
             if not job.future.done():
                 job.future.set_exception(WorkCancelled("backend closed"))
         self._jobs.clear()
         self._wakeup.set()
-        if self._engine_task is not None:
+        engine_task, self._engine_task = self._engine_task, None
+        if engine_task is not None:
             try:
-                await self._engine_task
+                await engine_task
             except Exception:
                 # The engine already failed its waiters before dying; its
                 # exception must not break teardown too.
                 pass
-            self._engine_task = None
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
@@ -659,6 +661,7 @@ class JaxWorkBackend(WorkBackend):
                 if (b, steps) in self._warm:
                     continue
                 await self._timed_launch(np.stack([probe] * b), steps)
+                # dpowlint: disable=DPOW801 — one warm task exists per backend (close() joins it before a successor could start) and set.add is idempotent; a racing inline warm costs one duplicate compile, never corrupts state
                 self._warm.add((b, steps))
         except asyncio.CancelledError:
             raise

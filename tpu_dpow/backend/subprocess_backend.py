@@ -65,6 +65,8 @@ class SubprocessWorkBackend(WorkBackend):
             pass  # cancel is advisory, never fatal (reference behavior)
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        # Detach-then-await (dpowlint DPOW801): a concurrent close() must
+        # find the slot empty instead of double-closing the session.
+        session, self._session = self._session, None
+        if session is not None:
+            await session.close()

@@ -233,6 +233,12 @@ class DpowClient:
         await runner.setup()
         site = web.TCPSite(runner, self.config.metrics_host, self.config.metrics_port)
         await site.start()
+        if self._metrics_runner is not None:
+            # A concurrent starter won the slot while we were binding
+            # (dpowlint DPOW801): one metrics endpoint per client — ours
+            # must go, or the loser's runner leaks its socket forever.
+            await runner.cleanup()
+            return
         self._metrics_runner = runner
         self.metrics_port = site._server.sockets[0].getsockname()[1]
         logger.info("metrics served on :%d/metrics", self.metrics_port)
@@ -479,9 +485,9 @@ class DpowClient:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
-        if self._metrics_runner is not None:
-            await self._metrics_runner.cleanup()
-            self._metrics_runner = None
+        metrics_runner, self._metrics_runner = self._metrics_runner, None
+        if metrics_runner is not None:
+            await metrics_runner.cleanup()
             self.metrics_port = None
         if self.work_handler._started:
             await self.work_handler.stop()

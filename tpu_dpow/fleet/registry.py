@@ -211,20 +211,31 @@ class WorkerRegistry:
                 self._sync_gauges()
             return None
         info = self._workers.get(worker_id)
+        if info is None:
+            # Capacity check-then-insert, re-validated after every await
+            # (dpowlint DPOW801): the eviction suspends on the store, and
+            # a concurrent announce can take the freed slot — or register
+            # this very id — while we are parked. Without the loop two
+            # concurrent fresh announces both pass one len() check and the
+            # MAX_WORKERS bound overshoots (pinned by
+            # test_fleet.test_announce_capacity_race_holds_bound).
+            while (
+                worker_id not in self._workers
+                and len(self._workers) >= self.max_workers
+            ):
+                if not await self._evict_one_stale():
+                    # Every slot holds a LIVE worker: refuse the fresh id
+                    # rather than let announce floods grow memory/store/
+                    # gauges without bound (see MAX_WORKERS).
+                    self._m_announces.inc(1, "rejected")
+                    logger.warning(
+                        "fleet registry full (%d live); rejecting fresh id %s",
+                        self.max_workers, worker_id,
+                    )
+                    return None
+            info = self._workers.get(worker_id)
         fresh = info is None
         if fresh:
-            if len(self._workers) >= self.max_workers and not (
-                await self._evict_one_stale()
-            ):
-                # Every slot holds a LIVE worker: refuse the fresh id
-                # rather than let announce floods grow memory/store/gauges
-                # without bound (see MAX_WORKERS).
-                self._m_announces.inc(1, "rejected")
-                logger.warning(
-                    "fleet registry full (%d live); rejecting fresh id %s",
-                    self.max_workers, worker_id,
-                )
-                return None
             info = WorkerInfo(worker_id=worker_id)
             self._workers[worker_id] = info
         info.backend = str(data.get("backend", info.backend))

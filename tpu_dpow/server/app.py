@@ -263,19 +263,23 @@ class DpowServer:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
-        if self._bg_tasks:
+        # Detach the drain set before awaiting (dpowlint DPOW801): a write
+        # spawned by a still-unwinding handler DURING the wait lands in the
+        # fresh set instead of being silently dropped by a clear() racing
+        # the handler.
+        draining, self._bg_tasks = set(self._bg_tasks), set()
+        if draining:
             # Let in-flight counter/frontier writes land before the store
             # goes away — but bounded: against a hung store (degraded
             # backend mid-outage, chaos HANG) shutdown must not block
             # forever on a fire-and-forget counter.
-            done, pending = await asyncio.wait(set(self._bg_tasks), timeout=2.0)
+            done, pending = await asyncio.wait(draining, timeout=2.0)
             for t in pending:
                 t.cancel()
             if pending:
                 await asyncio.gather(*pending, return_exceptions=True)
             for t in done:
                 t.exception()  # consume, writes are best-effort
-            self._bg_tasks.clear()
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             # Same split as the checkpoint loop: snapshot on the loop,
             # write in a thread — and never let a failed final checkpoint
@@ -875,6 +879,34 @@ class DpowServer:
                     await asyncio.wait_for(asyncio.shield(gate), timeout=remaining)
                 except asyncio.TimeoutError:
                     raise RequestTimeout()
+                if block_hash not in self.work_futures:
+                    # The dispatcher died instead of installing a dispatch
+                    # (cancelled while queued for admission). A hash with
+                    # work already IN FLIGHT — a precache publish, or a
+                    # prior dispatch torn down between its publish and its
+                    # result — can resolve in exactly this window, and the
+                    # futures map forgets it the moment the teardown runs:
+                    # the STORE, not the map, holds the answer. Without
+                    # this check the promoted waiter re-dispatches the
+                    # solved hash and strands until timeout — the result
+                    # handler drops every later result at the
+                    # not-WORK_PENDING check (dpowsan's coalesce scenario;
+                    # pinned by test_chaos's promote-window race test).
+                    solved = await self.store.get(f"block:{block_hash}")
+                    if solved and solved != WORK_PENDING:
+                        if nc.work_value(block_hash, solved) >= difficulty:
+                            self._m_coalesce.inc(1, "gated")
+                            return solved
+                        # Solved, but below THIS request's target: final
+                        # validation would bounce it as RetryRequest. Reset
+                        # the frontier (the entry-path weak-precache idiom)
+                        # so the promotion below re-dispatches at our
+                        # difficulty and its results are accepted again.
+                        await self.store.set(
+                            f"block:{block_hash}", WORK_PENDING,
+                            expire=self.config.block_expiry,
+                        )
+                        await self.store.delete(f"block-lock:{block_hash}")
                 # Loop: the dispatch now exists (attach below), or the
                 # dispatcher failed — in which case one of the gated
                 # requests PROMOTES to dispatcher on its next pass, so a
@@ -895,11 +927,42 @@ class DpowServer:
                     deadline=deadline,
                     over_quota=over_quota,
                 )
+                if ticket.future is not None:
+                    # The ticket WAITED in the admission queue (future is
+                    # only set on the queued path — a synchronous grant
+                    # never pays this check). While we queued, work for
+                    # this hash that was already in flight — a precache
+                    # publish, or a torn-down predecessor's late result —
+                    # may have resolved into the store; dispatching now
+                    # would publish a solved hash whose every result the
+                    # handler drops as stale, stranding us to the deadline
+                    # (dpowsan's bounded-window coalesce seeds; pinned in
+                    # test_chaos).
+                    solved = await self.store.get(f"block:{block_hash}")
+                    if solved and solved != WORK_PENDING:
+                        if nc.work_value(block_hash, solved) >= difficulty:
+                            self.admission.release(ticket)
+                            ticket = None
+                            return solved
+                        # Solved below THIS request's target (a weaker
+                        # waiter's predecessor got there first): keep the
+                        # slot, reset the frontier, and dispatch at our
+                        # own difficulty below — same idiom as the entry
+                        # path's too-weak precache reset.
+                        await self.store.set(
+                            f"block:{block_hash}", WORK_PENDING,
+                            expire=self.config.block_expiry,
+                        )
+                        await self.store.delete(f"block-lock:{block_hash}")
                 if block_hash in self.work_futures:
                     # A concurrent dispatcher won the hash while we waited
-                    # in the queue (reachable with --no_coalesce, where no
-                    # gate serializes dispatchers): the dispatch exists,
-                    # hand the slot back and join it as a plain waiter.
+                    # in the queue or in the store read above (reachable
+                    # with --no_coalesce, where no gate serializes
+                    # dispatchers): the dispatch exists, hand the slot
+                    # back and join it as a plain waiter. Placed AFTER the
+                    # last await of this prologue on purpose — nothing may
+                    # suspend between this membership check and the
+                    # install below (DPOW801).
                     self.admission.release(ticket)
                     ticket = None
                     break
@@ -986,11 +1049,22 @@ class DpowServer:
                     # own — popping by key would destroy the successor's
                     # future out from under it.
                     if self.work_futures.get(block_hash) is created:
+                        # dpowlint: disable=DPOW801 — every side table lives and dies with the work_futures entry; the identity guard above re-validates them all after the awaits
                         self._drop_dispatch_state(block_hash)
                     if not created.done():
                         created.cancel()
                     raise
             finally:
+                # A ticket still held HERE never made it into
+                # _dispatch_tickets (a cancellation or store error in the
+                # prologue between the grant and the transfer — e.g. inside
+                # the queued-path store re-check above): hand the window
+                # slot back, or with a bounded window every such exit
+                # shrinks capacity forever (pinned by test_chaos's
+                # cancelled-mid-recheck slot-release test).
+                if ticket is not None:
+                    self.admission.release(ticket)
+                    ticket = None
                 # Open the gate LAST — success or failure — so coalesced
                 # requests either find the installed dispatch or promote.
                 if self._dispatch_gates.get(block_hash) is gate:
@@ -1084,7 +1158,14 @@ class DpowServer:
             if not work or work == WORK_PENDING:
                 raise RetryRequest()
         except asyncio.TimeoutError:
-            raise RequestTimeout()
+            # Same store-beats-map rule as the CancelledError path: this
+            # future can be a void re-dispatch of a hash whose result
+            # landed while its predecessor's teardown raced the winner —
+            # nothing will ever resolve it, but the work is sitting in the
+            # store. Answer from the store before giving up the deadline.
+            work = await self.store.get(f"block:{block_hash}")
+            if not work or work == WORK_PENDING:
+                raise RequestTimeout()
         finally:
             # Refcounted teardown: the future dies with its LAST waiter —
             # one impatient short-timeout request must not abort concurrent
@@ -1097,6 +1178,7 @@ class DpowServer:
                 # future IT awaited — by now the key may hold a successor
                 # dispatch's fresh future, which must stay.
                 if self.work_futures.get(block_hash) is fut:
+                    # dpowlint: disable=DPOW801 — side tables live and die with the work_futures entry; the identity guard above re-validates them all after the awaits
                     self._drop_dispatch_state(block_hash)
                 if not fut.done():
                     fut.cancel()

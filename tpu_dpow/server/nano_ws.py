@@ -119,7 +119,9 @@ class NanoWebsocketClient:
 
     async def stop(self) -> None:
         self._stopped = True
-        if self._task is not None:
-            self._task.cancel()
-            await asyncio.gather(self._task, return_exceptions=True)
-            self._task = None
+        # Detach-then-await (dpowlint DPOW801): concurrent stop() calls
+        # must not both cancel/await the same task.
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)

@@ -133,9 +133,12 @@ class DegradedStore(Store):
                     await self.primary.exists("__degraded_probe__")
                 except self.errors:
                     return  # still down; next probe a full interval away
+                # dpowlint: disable=DPOW801 — the _reconciling latch (set with no await after its check) serializes this whole block; no second coroutine can be in here
                 self._draining = True
+            # dpowlint: disable=DPOW801 — same latch: only one drain burst can be in flight
             await self._reconcile()
         finally:
+            # dpowlint: disable=DPOW801 — only the latch holder reaches here
             self._reconciling = False
 
     async def _reconcile(self) -> None:
@@ -166,6 +169,7 @@ class DegradedStore(Store):
                 # wedge recovery behind it forever.
                 logger.warning("journaled %s%r dropped on replay: %s",
                                method, args, e)
+            # dpowlint: disable=DPOW801 — _maybe_recover's _reconciling latch serializes _reconcile; concurrent ops only APPEND to the journal, so the replayed head entry is still index 0 when this pops it
             self._journal.popleft()
             replayed += 1
         self._m_journal_depth.set(len(self._journal))

@@ -59,9 +59,11 @@ class RedisStore(Store):
         await self._redis.ping()
 
     async def close(self) -> None:
-        if self._redis is not None:
-            await self._redis.aclose()
-            self._redis = None
+        # Detach-then-await (dpowlint DPOW801): a concurrent close() must
+        # find the slot empty instead of double-closing the pool.
+        redis, self._redis = self._redis, None
+        if redis is not None:
+            await redis.aclose()
 
     async def _c(self, coro):
         """Await a redis op, translating WRONGTYPE into TypeError."""

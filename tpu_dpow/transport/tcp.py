@@ -68,14 +68,16 @@ class TcpBrokerServer:
         logger.info("broker listening on %s:%s", self.host, self.port)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
+        # Detach-then-await (dpowlint DPOW801): concurrent stop() calls
+        # must not both close/await the same server.
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
             # Drop live connections too: 3.12's wait_closed() blocks until
             # every handler finishes, and handlers block on reads otherwise.
             for writer in list(self._conns):
                 writer.close()
-            await self._server.wait_closed()
-            self._server = None
+            await server.wait_closed()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn = FrameConn(self.broker, "tcp")

@@ -79,9 +79,11 @@ class WsBrokerServer:
     async def stop(self) -> None:
         for ws in list(self._conns):
             await ws.close()
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # Detach-then-await (dpowlint DPOW801): one cleanup per runner
+        # even under concurrent stop() calls.
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
 
     async def _handle(self, request: web.Request) -> web.WebSocketResponse:
         # protocols=("mqtt",): stock browser MQTT clients (mqtt.js & co.)
@@ -267,9 +269,12 @@ class WsTransport(TcpTransport):
 
     async def close(self) -> None:
         await super().close()
-        if self._closing:
-            await asyncio.gather(*self._closing, return_exceptions=True)
-            self._closing.clear()
-        if self._http is not None:
-            await self._http.close()
-            self._http = None
+        # Detach-then-await (dpowlint DPOW801): a ws teardown task spawned
+        # DURING the gather lands in the fresh list instead of being
+        # dropped — half-closed sockets must stay awaitable.
+        closing, self._closing = self._closing, []
+        if closing:
+            await asyncio.gather(*closing, return_exceptions=True)
+        http, self._http = self._http, None
+        if http is not None:
+            await http.close()

@@ -111,7 +111,9 @@ class WorkServer:
         logger.info("work server listening on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # Detach-then-await (dpowlint DPOW801): one cleanup per runner
+        # even under concurrent stop() calls.
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
         await self.backend.close()
