@@ -32,6 +32,20 @@ CODEC_GOLDENS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_wire.py --collect-only -q -p no:cacheprovider \
     2>/dev/null | grep -c '::' || true)
 echo "CODEC_GOLDENS=${CODEC_GOLDENS}"
+# Replication headline (ISSUE 9): the kill-one-of-three chaos acceptance
+# test (tests/test_replica.py), re-run standalone — FakeClock-driven, a
+# few seconds — so the headline is pass/fail, not a log grep (passing
+# tests are invisible in -q output).
+if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_replica.py::test_chaos_kill_one_of_three_replicas_mid_burst \
+    -q -p no:cacheprovider >/dev/null 2>&1; then
+    REPLICA_TESTS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_replica.py --collect-only -q -p no:cacheprovider \
+        2>/dev/null | grep -c '::' || true)
+    echo "REPLICA=pass tests=${REPLICA_TESTS}"
+else
+    echo "REPLICA=fail"
+fi
 # dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
 # or how many findings escaped the baseline (docs/analysis.md).
 DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)

@@ -108,6 +108,13 @@ class FakeRedis:
         return [k for k in list(self._data) if self._live(k)
                 and fnmatch.fnmatchcase(k, pattern)]
 
+    async def scan_iter(self, match: str = "*", count: int = 10):
+        # redis.asyncio's cursor walk, collapsed: same glob semantics as
+        # KEYS, yielded incrementally (RedisStore.keys iterates this so
+        # production never issues a blocking full-keyspace KEYS).
+        for k in await self.keys(match):
+            yield k
+
     # -- hashes ------------------------------------------------------------
 
     def _hash(self, key: str) -> Dict[str, str]:

@@ -21,6 +21,7 @@ from tpu_dpow.analysis import (
     flags,
     locks,
     metrics,
+    replica_keys,
     sanitizer,
     tasks,
     topics,
@@ -911,6 +912,57 @@ def test_taint_quiet_after_decode_boundary_and_in_boundary_module(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DPOW901 replica-key-fence
+# ---------------------------------------------------------------------------
+
+
+def test_replica_keys_fire_on_unfenced_writes(tmp_path):
+    """Every write-shape the checker claims to classify must fire outside
+    fence.py: string literal, leading-literal f-string, module constant,
+    and a fence key-helper call with no literal at the call site."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/bad.py": (
+                "from tpu_dpow.replica.fence import member_key\n\n"
+                "EPOCH_KEY = 'replica:epoch'\n\n"
+                "async def mutate(store, rid):\n"
+                "    await store.set('replica:member:r1', 'x')\n"
+                "    await store.delete(f'replica:adopt:{rid}')\n"
+                "    await store.incrby(EPOCH_KEY)\n"
+                "    await store.hset(member_key(rid), {'seq': '1'})\n"
+            )
+        },
+    )
+    found = replica_keys.check(project)
+    assert len(found) == 4
+    assert codes(found) == ["DPOW901"]
+
+
+def test_replica_keys_quiet_on_fence_reads_and_foreign_keys(tmp_path):
+    """Must NOT fire: fence.py itself (the one sanctioned writer), read
+    methods on replica:* keys, non-replica writes, and an f-string key
+    that opens with a placeholder (statically unclassifiable)."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/replica/fence.py": (
+                "async def raise_fence(store, rid):\n"
+                "    await store.set(f'replica:fence:{rid}', '1')\n"
+            ),
+            "tpu_dpow/good.py": (
+                "async def observe(store, rid, prefix):\n"
+                "    await store.get('replica:member:r1')\n"
+                "    await store.hgetall(f'replica:member:{rid}')\n"
+                "    await store.set('block:abc', 'w')\n"
+                "    await store.set(f'{prefix}:member:{rid}', 'x')\n"
+            ),
+        },
+    )
+    assert replica_keys.check(project) == []
+
+
+# ---------------------------------------------------------------------------
 # dpowsan: the schedule-perturbing confirmer
 # ---------------------------------------------------------------------------
 
@@ -925,6 +977,11 @@ def test_sanitizer_same_seed_same_interleaving_trace():
     assert b.ok and a.trace_digest == b.trace_digest
     c = sanitizer.run_seed("coalesce", 6)
     assert c.ok and c.trace_digest != a.trace_digest
+    # the replicated takeover scenario rides the same contract
+    t1 = sanitizer.run_seed("takeover", 5)
+    t2 = sanitizer.run_seed("takeover", 5)
+    assert t1.ok, t1.error
+    assert t2.ok and t1.trace_digest == t2.trace_digest
 
 
 def test_sanitizer_annotates_static_findings():

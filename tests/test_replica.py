@@ -1,0 +1,1138 @@
+"""Replicated orchestrator (tpu_dpow/replica/, docs/replication.md):
+
+  * ring/registry/fence units — deterministic rendezvous ownership with
+    minimal movement, skew-free heartbeat-seq death detection, and the
+    epoch fence that keeps a zombie replica from resurrecting state;
+  * construction refusal of a per-process memory:// store at --replicas > 1;
+  * cross-replica forwarding: a request landing on a non-owner is
+    dispatched by the ring owner and the forwarder's proxy resolves;
+  * the ISSUE 9 chaos acceptance: kill one of three replicas mid-burst —
+    every in-flight dispatch of the dead replica is adopted and served
+    within its original deadline, zero lost requests, a zombie publish
+    from the dead epoch is fenced, and dpow_replica_takeovers_total
+    accounts for every adopted dispatch;
+  * the zombie-epoch regression: a paused (not dead) replica is adopted,
+    every write and publish of its old epoch bounces, and it rejoins with
+    a fresh epoch instead of fighting its adopter;
+  * --lane_flush cross-dispatch micro-batching: different hashes
+    dispatched in the same event-loop tick share one WORK_BATCH frame.
+
+Everything is deterministic: one shared FakeClock drives heartbeats,
+ttls, and deadlines; replica cadence ticks are driven by explicit
+``poll()`` calls (the run loop sleeps 3600 fake seconds so it never
+interferes); the in-proc broker carries all cross-replica traffic.
+"""
+
+import asyncio
+import hashlib
+import json
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dpow import obs
+from tpu_dpow.chaos import FakeClock
+from tpu_dpow.replica import (
+    HashRing,
+    ReplicaCoordinator,
+    ReplicaRegistry,
+    StaleEpoch,
+    dispatch_topic,
+    owner_of,
+)
+from tpu_dpow.replica import fence
+from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+from tpu_dpow.server.app import WORK_PENDING
+from tpu_dpow.store import MemoryStore
+from tpu_dpow.transport import wire
+from tpu_dpow.transport.broker import Broker
+from tpu_dpow.transport.inproc import InProcTransport
+from tpu_dpow.transport.mqtt_codec import encode_result_payload
+from tpu_dpow.utils import nanocrypto as nc
+
+pytestmark = pytest.mark.chaos
+
+RNG = np.random.default_rng(9)
+EASY = 0xFF00000000000000  # ~256 hashes expected: instant everywhere
+PAYOUT = nc.encode_account(bytes(range(32)))
+
+
+def random_hash():
+    return RNG.bytes(32).hex().upper()
+
+
+def hash_owned_by(rid, members):
+    """A block hash whose rendezvous owner among ``members`` is ``rid``."""
+    while True:
+        h = random_hash()
+        if owner_of(h, members) == rid:
+            return h
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def solve(block_hash: str, difficulty: int) -> str:
+    h = bytes.fromhex(block_hash)
+    w = 0
+    while True:
+        v = int.from_bytes(
+            hashlib.blake2b(struct.pack("<Q", w) + h, digest_size=8).digest(),
+            "little",
+        )
+        if v >= difficulty:
+            return f"{w:016x}"
+        w += 1
+
+
+async def settle(rounds: int = 80):
+    """Event-loop settling only — all protocol timing rides the FakeClock."""
+    for _ in range(rounds):
+        await asyncio.sleep(0)
+
+
+# ----------------------------------------------------------------- ring
+
+
+def test_ring_deterministic_total_and_balanced():
+    members = ["ra", "rb", "rc"]
+    ring = HashRing(members, epoch=3)
+    hashes = [random_hash() for _ in range(600)]
+    # total: every hash has exactly one owner, and recomputation agrees
+    for h in hashes:
+        o = ring.owner_of(h)
+        assert o in members
+        assert owner_of(h, members) == o
+        assert owner_of(h, reversed(members)) == o  # order-free
+    # roughly balanced: rendezvous over 3 members splits ~1/3 each
+    counts = ring.slice_counts(hashes)
+    assert set(counts) == set(members)
+    for rid in members:
+        assert 120 <= counts[rid] <= 280, counts
+    assert owner_of(random_hash(), []) is None
+    assert HashRing([]).owner_of(random_hash()) is None
+
+
+def test_ring_minimal_movement_on_member_death():
+    before = HashRing(["ra", "rb", "rc"])
+    after = HashRing(["ra", "rc"])
+    hashes = [random_hash() for _ in range(600)]
+    moved = before.moved(after, hashes)
+    # ONLY rb's former slice moves — and every moved hash was rb's
+    assert set(moved) == {h for h in hashes if before.owner_of(h) == "rb"}
+    for h in moved:
+        assert after.owner_of(h) in ("ra", "rc")
+    # survivors keep their exact slices
+    for h in hashes:
+        if before.owner_of(h) != "rb":
+            assert after.owner_of(h) == before.owner_of(h)
+
+
+# ------------------------------------------------------- registry/fence
+
+
+def test_registry_heartbeat_staleness_and_rejoin():
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        store = MemoryStore()
+        a = ReplicaRegistry(store, "ra", clock=clock, ttl=2.0)
+        b = ReplicaRegistry(store, "rb", clock=clock, ttl=2.0)
+        assert await a.join() == 1
+        assert await b.join() == 2
+        await a.observe()
+        assert a.live_members() == ["ra", "rb"]
+        assert a.ring().epoch == 2  # max member epoch stamps the table
+
+        # rb heartbeats inside the ttl: stays live on a's clock
+        await clock.advance(1.5)
+        assert await b.heartbeat()
+        await a.observe()
+        assert a.is_live("rb") and not a.stale_peers()
+
+        # rb goes silent a full ttl: stale in a's view, droppable
+        await clock.advance(2.5)
+        await a.observe()
+        assert not a.is_live("rb")
+        assert [v.replica_id for v in a.stale_peers()] == ["rb"]
+
+        # adopter-side retirement fences rb and drops its record
+        await fence.retire_member(store, "rb", b.epoch)
+        await a.observe()
+        assert a.live_members() == ["ra"]
+        # the zombie notices on its next beat and can rejoin fresh
+        assert not await b.heartbeat()
+        assert b.fenced
+        new_epoch = await b.join()
+        assert new_epoch > 2 and not b.fenced
+        assert await b.heartbeat()
+        await a.observe()
+        assert a.live_members() == ["ra", "rb"]
+
+    run(main())
+
+
+def test_fence_refuses_zombie_writes_and_elects_one_adopter():
+    async def main():
+        obs.reset()
+        store = MemoryStore()
+        epoch = await fence.allocate_epoch(store)
+        w = fence.FencedWriter(store, "rx", epoch)
+        await w.journal_dispatch("AB" * 32, {"difficulty": 1})
+        assert [h for h, _ in await fence.read_dispatches(store, "rx")] == ["AB" * 32]
+
+        await fence.raise_fence(store, "rx", epoch + 1)
+        # a LOWER raise never un-fences
+        assert await fence.raise_fence(store, "rx", epoch) == epoch + 1
+        for op in (
+            w.write_member(1, 0.0),
+            w.journal_dispatch("CD" * 32, {}),
+            w.forget_dispatch("AB" * 32),
+            w.delete_member(),
+        ):
+            with pytest.raises(StaleEpoch):
+                await op
+        # the journal record survives the zombie's refused delete — it
+        # belongs to the adopter now
+        assert await fence.read_dispatches(store, "rx")
+        snap = obs.snapshot()
+        assert sum(
+            snap["dpow_replica_fenced_total"]["series"].values()
+        ) == 4
+
+        # adoption claim: exactly one winner per death event
+        wins = [
+            await fence.claim_adoption(store, "rx", epoch, expire=30.0)
+            for _ in range(3)
+        ]
+        assert wins == [True, False, False]
+        # a NEW death event (new epoch) re-opens the claim
+        assert await fence.claim_adoption(store, "rx", epoch + 7, expire=30.0)
+
+    run(main())
+
+
+def test_adoption_skips_a_rejoined_incarnations_fresh_journal():
+    """Post-review regression: the takeover journal is keyed by replica ID,
+    so a zombie that rejoins (fresh epoch, same id) mid-adoption journals
+    LIVE dispatches under the prefix the adopter is draining. The record's
+    epoch stamp distinguishes the incarnations — the adopter must skip
+    (and must NOT delete) records stamped above the dead epoch."""
+
+    async def main():
+        obs.reset()
+        store = MemoryStore()
+        clock = FakeClock()
+        adopted = []
+
+        async def adopt_cb(block_hash, record, dead_id):
+            adopted.append(block_hash)
+            return True
+
+        coord = ReplicaCoordinator(
+            store, replica_id="ra", clock=clock, ttl=2.0, adopt=adopt_cb
+        )
+        await coord.start()
+        # the dead incarnation journaled one in-flight dispatch…
+        dead_epoch = await fence.allocate_epoch(store)
+        old = fence.FencedWriter(store, "rx", dead_epoch)
+        await old.journal_dispatch("AB" * 32, {"difficulty": 1})
+        # …and the REJOINED incarnation (epoch above the fence the adopter
+        # is about to raise) journals a live one concurrently
+        new_epoch = await fence.allocate_epoch(store)
+        new = fence.FencedWriter(store, "rx", new_epoch)
+        await new.journal_dispatch("CD" * 32, {"difficulty": 1})
+
+        await coord._maybe_adopt("rx", dead_epoch)
+        assert adopted == ["AB" * 32]
+        # the live incarnation's record survives for its OWN death event
+        assert [h for h, _ in await fence.read_dispatches(store, "rx")] == [
+            "CD" * 32
+        ]
+        # …and its writer still writes (the fence stopped below it)
+        await new.journal_dispatch("EF" * 32, {"difficulty": 1})
+
+    run(main())
+
+
+def test_adopted_deadline_fully_spent_budget_aborts():
+    """Post-review regression: a journal record whose budget is spent on
+    BOTH clocks must yield a deadline <= now — the adopter's clean-abort
+    branch — while a record adopted at the wire with any budget left is
+    floored to one re-publish, and a coherent deadline is honored."""
+    now = 50.0
+    coherent = {"deadline": 60.0, "remaining": 15.0, "wall": time.time()}
+    assert ReplicaCoordinator.adopted_deadline(coherent, now) == 60.0
+    at_the_wire = {"deadline": 0.5, "remaining": 0.01, "wall": time.time()}
+    assert ReplicaCoordinator.adopted_deadline(at_the_wire, now) == now + 1.0
+    spent = {"deadline": 1.0, "remaining": 5.0, "wall": time.time() - 60.0}
+    assert ReplicaCoordinator.adopted_deadline(spent, now) == now
+    malformed = {"deadline": "x"}
+    assert ReplicaCoordinator.adopted_deadline(malformed, now) == now + 1.0
+
+
+# ------------------------------------------------------- server harness
+
+
+def replica_config(rid, **over):
+    defaults = dict(
+        base_difficulty=EASY,
+        throttle=1000.0,
+        heartbeat_interval=3600.0,
+        statistics_interval=3600.0,
+        work_republish_interval=5.0,
+        fleet=False,
+        replicas=3,
+        replica_id=rid,
+        replica_ttl=2.0,
+        replica_heartbeat_interval=3600.0,  # cadence driven by poll()
+    )
+    defaults.update(over)
+    return ServerConfig(**defaults)
+
+
+async def start_replica(broker, store, clock, rid, **over):
+    server = DpowServer(
+        replica_config(rid, **over),
+        store,
+        InProcTransport(broker, client_id=f"server-{rid}"),
+        clock=clock,
+    )
+    await server.setup()
+    server.start_loops()
+    return server
+
+
+async def register_service(store):
+    await store.hset(
+        "service:svc",
+        {"api_key": hash_key("secret"), "public": "N", "display": "svc",
+         "website": "", "precache": "0", "ondemand": "0"},
+    )
+    await store.sadd("services", "svc")
+
+
+def test_replicas_refuse_per_process_memory_store():
+    async def main():
+        obs.reset()
+        broker = Broker()
+        transport = InProcTransport(broker, client_id="server-r1")
+        with pytest.raises(ValueError, match="SHARED store"):
+            DpowServer(replica_config("r1"), MemoryStore(), transport)
+        # a deliberately shared instance IS a shared store (tests/benchmarks)
+        DpowServer(replica_config("r1"), MemoryStore(shared=True), transport)
+        # a single-process server keeps accepting plain memory://
+        DpowServer(
+            replica_config("r1", replicas=1), MemoryStore(), transport
+        )
+
+    run(main())
+
+
+def test_forwarded_request_is_dispatched_by_owner_and_served():
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra", replicas=2)
+        b = await start_replica(broker, store, clock, "rb", replicas=2)
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+            assert a.replica.registry.live_members() == ["ra", "rb"]
+
+            h = hash_owned_by("rb", ["ra", "rb"])
+            req = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 20}
+            ))
+            await settle()
+            # the forwarder installed a supervised proxy; the OWNER runs
+            # the dispatch (journaled for takeover) — one publish ring-wide
+            assert h in a._forwarded and a.supervisor.tracked(h)
+            assert h in b.work_futures and h not in b._forwarded
+            assert [rh for rh, _ in await fence.read_dispatches(store, "rb")] == [h]
+            snap = obs.snapshot()
+            assert snap["dpow_replica_requests_total"]["series"].get("forward", 0) == 1
+
+            # the worker answers on the shared result plane; both replicas
+            # hear it, one wins the store election, the forwarder's proxy
+            # resolves either from the shared plane or the addressed relay
+            work = solve(h, EASY)
+            await b.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, PAYOUT)
+            )
+            await settle()
+            assert await asyncio.wait_for(req, 10) == {"work": work, "hash": h}
+            await settle()
+            for s in (a, b):
+                assert not s.work_futures and not s._forward_origins
+            assert await fence.read_dispatches(store, "rb") == []
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())
+
+
+# --------------------------------------- ISSUE 9 acceptance: kill 1 of 3
+
+
+def test_chaos_kill_one_of_three_replicas_mid_burst():
+    """Three replicas share one store/broker/clock; a burst of requests
+    lands across the ring with rb owning every hash; rb is killed with all
+    of them in flight. Acceptance: every dispatch of the dead replica is
+    adopted (takeovers_total accounts for each), every surviving waiter is
+    served within its original deadline, zero requests lost, and a zombie
+    publish from rb's dead epoch is fenced."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra")
+        b = await start_replica(broker, store, clock, "rb")
+        c = await start_replica(broker, store, clock, "rc")
+        replicas = {"ra": a, "rb": b, "rc": c}
+        try:
+            for s in replicas.values():
+                await s.replica.poll()
+            await settle()
+            for s in replicas.values():
+                assert s.replica.registry.live_members() == ["ra", "rb", "rc"]
+            b_epoch = b.replica.registry.epoch
+
+            # mid-burst state: 4 forwarded requests (2 via ra, 2 via rc)
+            # plus one rb-local request — every hash owned by rb, every
+            # dispatch journaled under rb, nothing resolved yet
+            members = ["ra", "rb", "rc"]
+            hashes = {
+                "ra": [hash_owned_by("rb", members) for _ in range(2)],
+                "rc": [hash_owned_by("rb", members) for _ in range(2)],
+                "rb": [hash_owned_by("rb", members)],
+            }
+            reqs = {}
+            for rid, hs in hashes.items():
+                for h in hs:
+                    reqs[h] = asyncio.ensure_future(
+                        replicas[rid].service_handler({
+                            "user": "svc", "api_key": "secret",
+                            "hash": h, "timeout": 25,
+                        })
+                    )
+            await settle(200)
+            all_hashes = [h for hs in hashes.values() for h in hs]
+            journal = {rh for rh, _ in await fence.read_dispatches(store, "rb")}
+            assert journal == set(all_hashes)
+            for h in all_hashes:
+                assert await store.get(f"block:{h}") == WORK_PENDING
+                assert not reqs[h].done()
+
+            # SIGKILL-equivalent: no teardown courtesy, store state stays
+            await b.crash()
+
+            # Skew-free death detection needs two observations with NO seq
+            # movement between them: ra's next tick absorbs rb's final
+            # heartbeat first…
+            await a.replica.poll()
+            # …rc keeps its own seq moving mid-window (so ra never
+            # mistakes it for a corpse)…
+            await clock.advance(1.0)
+            await c.replica.poll()
+            # …then ra's first tick past the ttl sees rb's seq frozen,
+            # wins the adoption claim, fences the dead epoch, and adopts
+            # the journal (re-arming supervision + re-publish)
+            takeovers = obs.get_registry().counter("dpow_replica_takeovers_total")
+            before = takeovers.value()
+            await clock.advance(2.1)
+            await a.replica.poll()
+            await settle(200)
+            assert takeovers.value() - before == len(all_hashes)
+            assert a.replica.adopted_from == {"rb"}
+            # rc's later tick sees the retired member record: no double
+            # adoption, and the counter does not move again
+            await c.replica.poll()
+            await settle()
+            assert takeovers.value() - before == len(all_hashes)
+            assert not c.replica.adopted_from
+            # the dead member left every live view
+            assert a.replica.registry.live_members() == ["ra", "rc"]
+
+            # ZOMBIE: rb's old epoch is fenced everywhere — store writes
+            # bounce, and its stamped replica-plane publishes are refused
+            assert not await b.replica.registry.heartbeat()
+            assert b.replica.registry.fenced
+            with pytest.raises(StaleEpoch):
+                await b.replica.journal_dispatch(
+                    random_hash(), EASY, "ondemand", clock.time() + 5
+                )
+            zombie_forward = json.dumps({
+                "v": 1, "hash": hash_owned_by("ra", ["ra", "rc"]),
+                "difficulty": EASY, "from": "rb", "epoch": b_epoch,
+                "budget": 5.0,
+            })
+            await a._replica_forward_handler(zombie_forward)
+            await settle()
+            snap = obs.snapshot()
+            assert snap["dpow_replica_zombie_ignored_total"]["series"].get(
+                "forward", 0) == 1
+
+            # rb's local waiter died with it (its client lost the socket):
+            # clean abort, and the refused journal delete is swallowed
+            rb_h = hashes["rb"][0]
+            reqs[rb_h].cancel()
+            await asyncio.gather(reqs[rb_h], return_exceptions=True)
+
+            # the adopter re-published every dispatch; the worker answers
+            # on the shared plane and EVERY surviving waiter is served the
+            # validated work inside its original 25 s deadline (fake time
+            # spent so far: 3 s)
+            for h in all_hashes:
+                work = solve(h, EASY)
+                await a.client_result_handler(
+                    "result/ondemand", encode_result_payload(h, work, PAYOUT)
+                )
+                await settle()
+                if h == rb_h:
+                    continue
+                assert await asyncio.wait_for(reqs[h], 10) == {
+                    "work": work, "hash": h,
+                }
+            assert clock.time() < 25.0
+
+            # zero lost, nothing stranded, every side table torn down —
+            # the adopted orphan (rb's local hash) included
+            await settle(200)
+            for rid in ("ra", "rc"):
+                s = replicas[rid]
+                assert not s.work_futures, rid
+                assert not s._forward_origins and not s._adopted_orphan, rid
+                assert not s._future_waiters, rid
+            assert await fence.read_dispatches(store, "rb") == []
+
+            # the ring keeps serving: a fresh request on the survivors
+            h2 = hash_owned_by("ra", ["ra", "rc"])
+            req2 = asyncio.ensure_future(c.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h2, "timeout": 20}
+            ))
+            await settle(200)
+            work2 = solve(h2, EASY)
+            await a.client_result_handler(
+                "result/ondemand", encode_result_payload(h2, work2, PAYOUT)
+            )
+            assert await asyncio.wait_for(req2, 10) == {"work": work2, "hash": h2}
+        finally:
+            for s in replicas.values():
+                await s.close()
+
+    run(main())
+
+
+# ------------------------------------------------- zombie-epoch fencing
+
+
+def test_chaos_zombie_replica_is_fenced_and_rejoins_fresh():
+    """FakeClock regression for the zombie window: rb PAUSES (wedged loop,
+    not dead) past the ttl, ra adopts its in-flight dispatch, and the
+    returning rb must be unable to act under its old epoch — its relay of
+    the stale result is refused, its journal write bounces — until it
+    rejoins with a fresh epoch and serves again."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra", replicas=2)
+        b = await start_replica(broker, store, clock, "rb", replicas=2)
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+            b_epoch = b.replica.registry.epoch
+
+            # a request forwarded ra → rb is in flight when rb wedges
+            h = hash_owned_by("rb", ["ra", "rb"])
+            req = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+            ))
+            await settle()
+            assert {rh for rh, _ in await fence.read_dispatches(store, "rb")} == {h}
+
+            # rb stops polling (paused, NOT crashed: loops still up); ra
+            # absorbs rb's last heartbeat, then a full silent ttl later
+            # declares it dead and adopts
+            await a.replica.poll()
+            await clock.advance(3.0)
+            await a.replica.poll()
+            await settle()
+            assert a.replica.adopted_from == {"rb"}
+            assert obs.get_registry().counter(
+                "dpow_replica_takeovers_total").value() == 1
+
+            # rb wakes and tries to act under the dead epoch: the
+            # addressed relay it sends is REFUSED by the receiver's fence
+            work = solve(h, EASY)
+            stale_relay = json.dumps({
+                "v": 1, "hash": h, "work": work, "type": "ondemand",
+                "from": "rb", "epoch": b_epoch,
+            })
+            await a.client_result_handler("result/ra/ondemand", stale_relay)
+            await settle()
+            snap = obs.snapshot()
+            assert snap["dpow_replica_zombie_ignored_total"]["series"].get(
+                "relay", 0) == 1
+            assert not req.done()  # the fenced relay resolved nothing
+            # ...and its journal writes bounce at the store
+            with pytest.raises(StaleEpoch):
+                await b.replica.journal_dispatch(
+                    random_hash(), EASY, "ondemand", clock.time() + 5
+                )
+
+            # rb's own cadence notices the fence and rejoins FRESH
+            await b.replica.poll()
+            await settle()
+            assert b.replica.registry.epoch > b_epoch
+            assert not b.replica.registry.fenced
+            await a.replica.poll()
+            await settle()
+            assert a.replica.registry.live_members() == ["ra", "rb"]
+
+            # the adopted dispatch still serves: the worker result lands on
+            # the shared plane and the forwarder's proxy resolves
+            await a.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, PAYOUT)
+            )
+            assert await asyncio.wait_for(req, 10) == {"work": work, "hash": h}
+
+            # the REJOINED rb (fresh epoch) is a first-class member again:
+            # its relays pass the fence now — and ra's adoption
+            # bookkeeping reset on observing it live (post-review fix:
+            # rb's result lane is rb's own again, and rb's NEXT death is
+            # a new death event ra must be willing to adopt)
+            assert a.replica.adopted_from == set()
+            h2 = hash_owned_by("ra", ["ra", "rb"])
+            req2 = asyncio.ensure_future(b.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h2, "timeout": 20}
+            ))
+            await settle()
+            work2 = solve(h2, EASY)
+            await b.client_result_handler(
+                "result/ondemand", encode_result_payload(h2, work2, PAYOUT)
+            )
+            assert await asyncio.wait_for(req2, 10) == {"work": work2, "hash": h2}
+
+            # SECOND DEATH of the rejoined incarnation: without the
+            # adopted_from pruning above this adoption never fires and
+            # the forwarded waiter strands — the zero-lost guarantee dies
+            # on the second failure of any given replica id.
+            h3 = hash_owned_by("rb", ["ra", "rb"])
+            req3 = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h3, "timeout": 25}
+            ))
+            await settle()
+            assert {rh for rh, _ in await fence.read_dispatches(store, "rb")} == {h3}
+            await b.crash()
+            await a.replica.poll()  # absorb the final heartbeat
+            await clock.advance(3.0)
+            await a.replica.poll()  # detect + re-adopt
+            await settle()
+            assert a.replica.adopted_from == {"rb"}
+            assert obs.get_registry().counter(
+                "dpow_replica_takeovers_total").value() == 2
+            work3 = solve(h3, EASY)
+            await a.client_result_handler(
+                "result/ondemand", encode_result_payload(h3, work3, PAYOUT)
+            )
+            assert await asyncio.wait_for(req3, 10) == {"work": work3, "hash": h3}
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())
+
+
+def test_shed_forward_does_not_leak_relay_origins():
+    """Post-review regression: a forwarded dispatch shed at admission
+    (window full, queue 0 → Busy) creates NO dispatch state, so nothing
+    ever tears its _forward_origins entry down — under sustained overload
+    every shed forward leaked an entry and a later dispatch of the same
+    hash would relay its result to the stale origin."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        over = dict(
+            replicas=2, max_inflight_dispatches=1, admission_queue_limit=0
+        )
+        a = await start_replica(broker, store, clock, "ra", **over)
+        b = await start_replica(broker, store, clock, "rb", **over)
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+            # rb's only window slot is held by a local dispatch…
+            blocker = hash_owned_by("rb", ["ra", "rb"])
+            breq = asyncio.ensure_future(b.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": blocker,
+                 "timeout": 20}
+            ))
+            await settle()
+            assert blocker in b.work_futures
+            # …so ra's forward is shed at rb's door (Busy, queue 0)
+            h = hash_owned_by("rb", ["ra", "rb"])
+            while h == blocker:
+                h = hash_owned_by("rb", ["ra", "rb"])
+            req = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 20}
+            ))
+            await settle(200)
+            assert h not in b.work_futures
+            assert h not in b._forward_origins  # the fix
+            # the blocker still serves; the shed forward's proxy waiter is
+            # the forwarder's own business (deadline fallback / cancel)
+            work = solve(blocker, EASY)
+            await b.client_result_handler(
+                "result/ondemand", encode_result_payload(blocker, work, PAYOUT)
+            )
+            assert await asyncio.wait_for(breq, 10) == {
+                "work": work, "hash": blocker,
+            }
+            req.cancel()
+            await asyncio.gather(req, return_exceptions=True)
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())
+
+
+def test_adopter_crash_mid_takeover_reopens_election_and_rejournals():
+    """Two takeover-liveness regressions in one choreography. (1) The
+    adopter must NOT delete the dead member's record before the journal
+    drains: peers drop a vanished record from their views immediately, so
+    an adopter that dies mid-takeover would orphan the leftover journal
+    records forever — the adoption claim's TTL re-open was dead code.
+    (2) Adopted dispatches must be RE-JOURNALED under the adopter's own
+    id, or a second replica failure makes them unadoptable by anyone."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        # TTLs (the adoption claim's expiry) must ride the SAME fake
+        # clock as the protocol, or the claim re-open can't be driven
+        store = MemoryStore(clock=clock.time, shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra")
+        b = await start_replica(broker, store, clock, "rb")
+        c = await start_replica(broker, store, clock, "rc")
+        try:
+            for s in (a, b, c):
+                await s.replica.poll()
+            await settle()
+            members = ["ra", "rb", "rc"]
+            h1 = hash_owned_by("rb", members)
+            h2 = hash_owned_by("rb", members)
+            while h2 == h1:
+                h2 = hash_owned_by("rb", members)
+            req1 = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h1, "timeout": 30}
+            ))
+            req2 = asyncio.ensure_future(c.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h2, "timeout": 30}
+            ))
+            await settle(200)
+            assert {rh for rh, _ in await fence.read_dispatches(store, "rb")} \
+                == {h1, h2}
+
+            await b.crash()
+            # both observers absorb rb's final heartbeat…
+            await a.replica.poll()
+            await c.replica.poll()
+            # …ra keeps its own seq moving inside the window…
+            await clock.advance(1.0)
+            await a.replica.poll()
+            await clock.advance(1.1)
+            # …then rc sees rb stale first, wins the claim — but its
+            # adoption DIES mid-takeover
+            takeovers = obs.get_registry().counter(
+                "dpow_replica_takeovers_total")
+            before = takeovers.value()
+            real_cb = c.replica._adopt_cb
+            entered = asyncio.Event()
+
+            async def wedged_cb(block_hash, record, dead_id):
+                entered.set()
+                await asyncio.get_running_loop().create_future()  # parked
+
+            # A genuine adopter CRASH: the poll task dies inside the
+            # adoption pass, so the claim is never released and the pass
+            # never reaches its leftovers branch — only the claim TTL can
+            # re-open this election. (A callback that merely RAISES is the
+            # softer failure: the surviving adopter releases the claim and
+            # retries next poll — test_failed_adoption_releases_claim.)
+            c.replica._adopt_cb = wedged_cb
+            dying_poll = asyncio.ensure_future(c.replica.poll())
+            await asyncio.wait_for(entered.wait(), 5)
+            dying_poll.cancel()
+            await asyncio.gather(dying_poll, return_exceptions=True)
+            await settle()
+            assert c.replica.adopted_from == {"rb"}
+            assert takeovers.value() == before
+            # the member record SURVIVES the failed adoption (pre-fix it
+            # was deleted up front and the death became undetectable)…
+            assert "rb" in await fence.read_members(store)
+            assert {rh for rh, _ in await fence.read_dispatches(store, "rb")} \
+                == {h1, h2}
+            # …and while rc's claim is alive, ra stands down
+            await a.replica.poll()
+            await settle()
+            assert not a.replica.adopted_from
+            c.replica._adopt_cb = real_cb
+
+            # the claim TTL (max(ttl*4, 20)) re-opens the election: the
+            # survivors keep heartbeating in sub-ttl steps (so only rb
+            # stays stale), and ra's first poll past the expiry wins the
+            # reopened claim and adopts the leftovers
+            for _ in range(11):
+                await clock.advance(1.9)
+                await c.replica.poll()
+                await a.replica.poll()
+            await settle(200)
+            assert a.replica.adopted_from == {"rb"}
+            assert takeovers.value() - before == 2
+            assert await fence.read_dispatches(store, "rb") == []
+            assert "rb" not in await fence.read_members(store)
+            # the adopted dispatches are journaled under the ADOPTER now
+            # (pre-fix: nowhere — a second death stranded them)
+            rejournal = {
+                rh: r for rh, r in await fence.read_dispatches(store, "ra")
+            }
+            assert set(rejournal) == {h1, h2}
+            assert rejournal[h2].get("origins") == ["rc"]
+
+            # SECOND death: the adopter dies too; rc adopts from ra's
+            # re-journal and the surviving waiter is still served
+            await a.crash()
+            req1.cancel()  # ra's local waiter died with ra
+            await asyncio.gather(req1, return_exceptions=True)
+            await c.replica.poll()
+            await clock.advance(2.1)
+            await c.replica.poll()
+            await settle(200)
+            assert takeovers.value() - before == 4
+            assert await fence.read_dispatches(store, "ra") == []
+            work2 = solve(h2, EASY)
+            await c.client_result_handler(
+                "result/ondemand", encode_result_payload(h2, work2, PAYOUT)
+            )
+            assert await asyncio.wait_for(req2, 10) == {
+                "work": work2, "hash": h2,
+            }
+        finally:
+            for s in (a, b, c):
+                await s.close()
+
+    run(main())
+
+
+def test_raised_request_on_dead_owner_retargets_locally():
+    """Post-review regression: a raised-difficulty request joining a
+    FORWARDED hash whose ring owner has since died must re-target from
+    the forwarder itself — pre-fix the branch called route() (which falls
+    back to self when the owner is dead) and sent the forward frame to
+    its OWN dispatch lane: the frame looped back, added the replica to
+    its own _forward_origins (a useless self-relay at resolve), and no
+    re-publish at the raised target happened until the supervisor's
+    grace window."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra", replicas=2)
+        b = await start_replica(broker, store, clock, "rb", replicas=2)
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+            h = hash_owned_by("rb", ["ra", "rb"])
+            req1 = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 30}
+            ))
+            await settle(200)
+            assert h in a._forwarded
+
+            # the owner dies; a full ttl of silence makes it dead in ra's
+            # view (no adoption poll yet — the window the fix covers)
+            await b.crash()
+            await a.replica.poll()
+            await clock.advance(2.5)
+
+            hard = 0xFFC0000000000000  # 4x multiplier over EASY
+            req2 = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h,
+                 "timeout": 20, "multiplier": 4.0}
+            ))
+            await settle(200)
+            # re-targeted from HERE: the store records the raised target
+            # and no self-origin was installed by a looped forward frame
+            assert await store.get(f"block-difficulty:{h}") == f"{hard:016x}"
+            assert a._dispatched_difficulty[h] == hard
+            assert "ra" not in a._forward_origins.get(h, set())
+
+            work = solve(h, hard)
+            await a.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, PAYOUT)
+            )
+            assert await asyncio.wait_for(req1, 10) == {"work": work, "hash": h}
+            assert await asyncio.wait_for(req2, 10) == {"work": work, "hash": h}
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())
+
+
+def test_failed_adoption_releases_claim_and_adopter_retries():
+    """Takeover-liveness regression for the SOFT failure (the adopter
+    survives, an adopt callback raises — a transient store/transport error
+    during re-journal or re-publish): the pass must re-open the election
+    immediately (release the claim) and the adopter itself must retry on
+    its next poll. Pre-fix the adopter marked the peer adopted and stood
+    down forever, and the claim pinned every OTHER replica out until its
+    TTL — in a two-replica ring the leftover dispatches were stranded."""
+
+    async def main():
+        obs.reset()
+        store = MemoryStore()
+        clock = FakeClock()
+        attempts = []
+
+        async def flaky_cb(block_hash, record, dead_id):
+            attempts.append(block_hash)
+            if len(attempts) == 1:
+                raise RuntimeError("transient store error during adoption")
+            return True
+
+        coord = ReplicaCoordinator(
+            store, replica_id="ra", clock=clock, ttl=2.0, adopt=flaky_cb
+        )
+        await coord.start()
+        dead_epoch = await fence.allocate_epoch(store)
+        dead = fence.FencedWriter(store, "rx", dead_epoch)
+        await dead.write_member(1, 0.0)
+        await dead.journal_dispatch("AB" * 32, {"difficulty": 1})
+
+        await coord._maybe_adopt("rx", dead_epoch)
+        assert attempts == ["AB" * 32]
+        # the record survives the failed pass, the member record stays
+        # (the death remains detectable), and the claim is ALREADY gone —
+        # no TTL wait stands between the leftovers and the next claimant
+        assert [h for h, _ in await fence.read_dispatches(store, "rx")] \
+            == ["AB" * 32]
+        assert "rx" in await fence.read_members(store)
+        assert await store.get(fence.adopt_key("rx", dead_epoch)) is None
+
+        # the adopter itself retries (pre-fix: adopted_from made it stand
+        # down for the rest of this incarnation)
+        await coord._maybe_adopt("rx", dead_epoch)
+        assert attempts == ["AB" * 32] * 2
+        assert await fence.read_dispatches(store, "rx") == []
+        assert "rx" not in await fence.read_members(store)
+
+    run(main())
+
+
+def test_forward_store_hit_below_target_redispatches_not_relays():
+    """Weak-work guard on the forward store-hit path: a hash solved at a
+    WEAKER target while the forward frame was in flight (base-difficulty
+    precache vs a raised-difficulty request) must not be relayed — the
+    forwarder's final validation would bounce it into an error reply.
+    The owner resets the frontier and dispatches at the forwarded
+    difficulty instead (the entry-path weak-precache idiom)."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra", replicas=2)
+        b = await start_replica(broker, store, clock, "rb", replicas=2)
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+            hard = 0xFFC0000000000000  # 4x multiplier over EASY
+            h = hash_owned_by("rb", ["ra", "rb"])
+            weak = None
+            w = 0
+            while weak is None:
+                cand = f"{w:016x}"
+                v = nc.work_value(h, cand)
+                if EASY <= v < hard:
+                    weak = cand
+                w += 1
+            await store.set(f"block:{h}", weak, expire=300)
+            await store.set(f"work-type:{h}", "precache", expire=300)
+            frame = json.dumps({
+                "v": 1, "hash": h, "difficulty": hard, "from": "ra",
+                "epoch": a.replica.registry.epoch, "budget": 30.0,
+            })
+            sent = obs.get_registry().counter(
+                "dpow_replica_relays_total",
+                "Cross-replica result relays, by event", ("event",))
+            before = sent.value("sent")
+            await b._replica_forward_handler(frame)
+            await settle(200)
+            # no weak relay; frontier reset; re-dispatched at the raised
+            # target (pre-fix: early relay of the weak work, no dispatch)
+            assert sent.value("sent") == before
+            assert await store.get(f"block:{h}") == WORK_PENDING
+            assert h in b.work_futures
+            assert b._dispatched_difficulty[h] == hard
+            # a STRONG result now serves, and the relay carries it
+            work = solve(h, hard)
+            await b.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, PAYOUT)
+            )
+            await settle(200)
+            assert sent.value("sent") == before + 1
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())
+
+
+def test_failed_forward_dispatch_does_not_leak_relay_origins():
+    """Sibling of the shed-forward regression for the GENERIC failure
+    path: an unexpected exception inside the owner's dispatch (e.g. a
+    store error in admission while a DegradedStore primary is down) used
+    to leave the _forward_origins entry behind with no dispatch state to
+    tear it down — same leak, unguarded branch."""
+
+    async def main():
+        obs.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra", replicas=2)
+        b = await start_replica(broker, store, clock, "rb", replicas=2)
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+
+            async def boom(*args, **kwargs):
+                raise RuntimeError("admission store exploded")
+
+            b._dispatch_ondemand = boom
+            h = hash_owned_by("rb", ["ra", "rb"])
+            req = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 20}
+            ))
+            await settle(200)
+            assert h not in b.work_futures
+            assert h not in b._forward_origins  # the fix
+            req.cancel()
+            await asyncio.gather(req, return_exceptions=True)
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())
+
+
+# ------------------------------------- cross-dispatch micro-batching
+
+
+def test_lane_flush_batches_different_hashes_into_one_frame():
+    """--lane_flush (ROADMAP item 5 leftover): two DIFFERENT hashes
+    dispatched in the same event-loop tick ride ONE WORK_BATCH frame on a
+    v1 worker's lane; without the flag each dispatch publishes its own
+    frame. Counted by the existing codec metrics."""
+
+    async def main():
+        for flush, want_publishes in ((True, 1), (False, 2)):
+            obs.reset()
+            clock = FakeClock()
+            broker = Broker()
+            store = MemoryStore()
+            config = ServerConfig(
+                base_difficulty=EASY, throttle=1000.0,
+                heartbeat_interval=3600.0, statistics_interval=3600.0,
+                fleet=True, fleet_min_workers=1, lane_flush=flush,
+            )
+            server = DpowServer(
+                config, store, InProcTransport(broker, client_id="server"),
+                clock=clock,
+            )
+            await server.setup()
+            server.start_loops()
+            await register_service(store)
+            # one v1-capable worker: both dispatches shard onto its lane
+            await server.fleet.on_announce(
+                json.dumps({"id": "w1", "hashrate": 1.0e6, "codec": 1})
+            )
+            observer = InProcTransport(broker, client_id="observer")
+            await observer.connect()
+            await observer.subscribe("work/#", qos=1)
+            frames = []
+
+            async def watch():
+                async for msg in observer.messages():
+                    frames.append(msg.payload)
+
+            watcher = asyncio.ensure_future(watch())
+            try:
+                h1, h2 = random_hash(), random_hash()
+                reqs = [
+                    asyncio.ensure_future(server.service_handler(
+                        {"user": "svc", "api_key": "secret", "hash": h,
+                         "timeout": 20}
+                    ))
+                    for h in (h1, h2)
+                ]
+                await settle(200)
+                assert len(frames) == want_publishes, (flush, frames)
+                got = set()
+                for frame in frames:
+                    for item in wire.decode_work_any(frame):
+                        # v1 decode returns native (lowercase) hashes
+                        got.add(item[0].upper())
+                assert got == {h1, h2}
+                if flush:
+                    # one frame, two items: the v1 WORK_BATCH header
+                    assert frames[0].encode("latin-1")[0] == 0x12
+                for h, req in zip((h1, h2), reqs):
+                    work = solve(h, EASY)
+                    await server.client_result_handler(
+                        "result/ondemand", encode_result_payload(h, work, PAYOUT)
+                    )
+                    assert await asyncio.wait_for(req, 10) == {
+                        "work": work, "hash": h,
+                    }
+            finally:
+                watcher.cancel()
+                await asyncio.gather(watcher, return_exceptions=True)
+                await observer.close()
+                await server.close()
+
+    run(main())

@@ -370,3 +370,176 @@ def test_sqlite_exists_and_type_check_cover_all_kinds(tmp_path):
         await s.close()
 
     run(main())
+
+
+def test_sqlite_keys_prefix_path_is_case_sensitive(tmp_path):
+    """Post-review regression: the keys() pure-prefix fast path filters in
+    SQL with LIKE, which is ASCII-case-INsensitive by default — diverging
+    from the case-sensitive fnmatch fallback and from Memory/Redis
+    semantics. Two replica ids differing only by case (both topic-safe)
+    would read each other's `replica:dispatch:` journal slice over sqlite,
+    so an adopter could double-dispatch a LIVE replica's in-flight work.
+    PRAGMA case_sensitive_like pins the fast path to the contract."""
+    from tpu_dpow.store.sqlite_store import SqliteStore
+
+    async def main():
+        s = SqliteStore(str(tmp_path / "s.db"))
+        await s.setup()
+        await s.set("replica:dispatch:RA:h1", "x")
+        await s.set("replica:dispatch:ra:h2", "y")
+        await s.hset("replica:member:RA", {"epoch": "1"})
+        await s.hset("replica:member:ra", {"epoch": "2"})
+        # prefix fast path (pure-glob tail)
+        assert await s.keys("replica:dispatch:ra:*") == [
+            "replica:dispatch:ra:h2"
+        ]
+        assert await s.keys("replica:member:ra*") == ["replica:member:ra"]
+        # and it agrees with the fnmatch fallback for the same slice
+        assert await s.keys("replica:dispatch:ra:h?") == [
+            "replica:dispatch:ra:h2"
+        ]
+        await s.close()
+
+    run(main())
+
+
+def test_sqlite_incrby_setnx_atomic_across_processes(tmp_path):
+    """Replication regression (docs/replication.md): several server
+    PROCESSES share one sqlite file, and the ring's epoch allocator
+    (incrby) plus the adoption election (setnx) are only correct if those
+    read-modify-writes are atomic ACROSS CONNECTIONS. Pre-fix (DEFERRED
+    isolation, read-then-write) a live 3-replica drive allocated the SAME
+    epoch to two replicas; BEGIN IMMEDIATE serializes them."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+
+    db = str(tmp_path / "shared.db")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Pre-seed elections whose prior round EXPIRED (a reopened adoption
+    # claim): _get_row's lazy expired-row DELETE used to COMMIT inside
+    # setnx's IMMEDIATE transaction, releasing the write lock mid-election
+    # so two processes could both "win" the reopened key.
+    import asyncio as _aio
+
+    async def _seed():
+        from tpu_dpow.store.sqlite_store import SqliteStore
+
+        s = SqliteStore(db)
+        await s.setup()
+        for i in range(5):
+            await s.set(f"replica:adopt:exp:{i}", "dead", expire=0.01)
+        await s.close()
+
+    sys.path.insert(0, repo)
+    _aio.run(_seed())
+    time.sleep(0.2)
+
+    script = (
+        "import asyncio, json, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from tpu_dpow.store.sqlite_store import SqliteStore\n"
+        "async def m():\n"
+        f"    s = SqliteStore({db!r})\n"
+        "    await s.setup()\n"
+        "    vals = [await s.incrby('replica:epoch') for _ in range(25)]\n"
+        "    wins = 0\n"
+        "    for i in range(5):\n"
+        "        wins += int(await s.setnx(f'replica:adopt:rx:{i}', 'w'))\n"
+        "    exp_wins = 0\n"
+        "    for i in range(5):\n"
+        "        exp_wins += int(await s.setnx(f'replica:adopt:exp:{i}', 'w'))\n"
+        "    await s.close()\n"
+        "    print(json.dumps({'vals': vals, 'wins': wins,\n"
+        "                      'exp_wins': exp_wins}))\n"
+        "asyncio.run(m())\n"
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for _ in range(4)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err
+        outs.append(json.loads(out))
+    allocated = [v for o in outs for v in o["vals"]]
+    # every increment landed: 100 allocations, all distinct, dense 1..100
+    assert sorted(allocated) == list(range(1, 101)), sorted(allocated)[:12]
+    # every election had exactly ONE winner across the four processes
+    assert sum(o["wins"] for o in outs) == 5
+    # ... including elections whose prior round expired (reopened claims)
+    assert sum(o["exp_wins"] for o in outs) == 5
+
+
+def test_sqlite_setnx_expired_key_election_stays_atomic(tmp_path):
+    """Deterministic companion to the cross-process test for the EXPIRED
+    branch: _get_row's lazy expired-row DELETE commits, and a commit
+    inside setnx's BEGIN IMMEDIATE releases the write lock mid-election,
+    letting a second connection win the same reopened key (both return
+    True). The fixed setnx checks liveness in SQL without _get_row; this
+    test widens the pre-fix window by pausing connection A exactly where
+    the old code dropped the lock (the patched seam is never reached
+    post-fix, so the pause is a no-op there)."""
+    import threading
+    import types
+
+    from tpu_dpow.store.sqlite_store import SqliteStore
+
+    db = str(tmp_path / "shared.db")
+    key = "replica:adopt:reopened"
+
+    async def seed():
+        s = SqliteStore(db)
+        await s.setup()
+        await s.set(key, "dead", expire=0.01)
+        await s.close()
+
+    run(seed())
+    import time as _time
+
+    _time.sleep(0.05)
+
+    paused = threading.Event()
+    proceed = threading.Event()
+    wins = []
+
+    def contender(patch_pause: bool):
+        async def m():
+            s = SqliteStore(db)
+            await s.setup()
+            if patch_pause:
+                orig = SqliteStore._get_row
+
+                def slow_get_row(self, k):
+                    res = orig(self, k)
+                    paused.set()
+                    proceed.wait(2)
+                    return res
+
+                s._get_row = types.MethodType(slow_get_row, s)
+                wins.append(await s.setnx(key, "A"))
+            else:
+                # B starts once A is parked in the old lock-released gap
+                # (pre-fix) or simply racing the held lock (post-fix; the
+                # 5 s busy timeout absorbs the wait).
+                paused.wait(0.5)
+                wins.append(await s.setnx(key, "B"))
+                proceed.set()
+            await s.close()
+
+        asyncio.new_event_loop().run_until_complete(m())
+
+    ta = threading.Thread(target=contender, args=(True,))
+    tb = threading.Thread(target=contender, args=(False,))
+    ta.start()
+    tb.start()
+    ta.join(10)
+    tb.join(10)
+    proceed.set()
+    assert sorted(wins) == [False, True], wins

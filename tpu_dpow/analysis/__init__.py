@@ -22,7 +22,17 @@ waiver syntax: docs/analysis.md.
 """
 
 from .core import Baseline, Finding, Project, run_all  # noqa: F401
-from . import blocking, clock, concurrency, flags, locks, metrics, tasks, topics  # noqa: F401
+from . import (  # noqa: F401
+    blocking,
+    clock,
+    concurrency,
+    flags,
+    locks,
+    metrics,
+    replica_keys,
+    tasks,
+    topics,
+)
 
 #: checker registry, in catalogue order (docs/analysis.md)
 CHECKERS = (
@@ -34,4 +44,5 @@ CHECKERS = (
     topics.check,
     flags.check,
     concurrency.check,
+    replica_keys.check,
 )

@@ -40,6 +40,7 @@ DPOW703  flag-drift          documented default != declared default
 DPOW801  await-interference  shared state checked, then mutated after an await
 DPOW802  lock-order          acquisition cycles / reentrant lock acquisition
 DPOW803  untrusted-input     raw transport payload consumed before the decode boundary
+DPOW901  replica-key-fence   replica:* store write outside replica/fence.py (unfenced)
 
 Waive inline with `# dpowlint: disable=CODE — justification` (applies to
 that line and the next); park intentional debt in the baseline file.

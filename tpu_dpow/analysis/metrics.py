@@ -25,7 +25,13 @@ from typing import Dict, List, Optional, Tuple
 from .core import Finding, Project
 
 #: catalogue locations, project docs_dir-relative
-DOC_FILES = ("observability.md", "resilience.md", "admission.md", "fleet.md")
+DOC_FILES = (
+    "observability.md",
+    "resilience.md",
+    "admission.md",
+    "fleet.md",
+    "replication.md",
+)
 
 _KINDS = {"counter", "gauge", "histogram"}
 

@@ -55,6 +55,7 @@ INSTRUMENTED_PREFIXES = (
     "tpu_dpow/fleet/",
     "tpu_dpow/sched/",
     "tpu_dpow/store/",
+    "tpu_dpow/replica/",
     "tpu_dpow/resilience/",
     "tpu_dpow/transport/broker.py",
     "tpu_dpow/transport/inproc.py",
@@ -493,9 +494,161 @@ async def scenario_fleet_recover(perturber: Perturber) -> None:
         await server.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario: replicated takeover vs the dead owner's late result
+# ---------------------------------------------------------------------------
+
+
+async def scenario_takeover(perturber: Perturber) -> None:
+    """Two ring replicas over one shared store; the owner dies with a
+    forwarded dispatch in flight, and the worker's result for it lands at
+    a seed-chosen instant DURING the survivor's adoption pass — before the
+    journal read, between the resolved-check and the re-publish, or after
+    the supervisor re-arm (the adopt-vs-late-result race, docs/replication.md
+    failure matrix). Invariants: the surviving waiter is served or aborts
+    cleanly — never stranded while the store holds the answer; the death
+    is adopted at most once (nothing double-dispatched); the dead owner's
+    journal drains; every per-dispatch side table on the survivor is torn
+    down."""
+    from .. import obs
+    from ..replica import fence, owner_of
+    from ..resilience.clock import FakeClock
+    from ..server import DpowServer, ServerConfig, hash_key
+    from ..server.app import WORK_PENDING
+    from ..server.exceptions import RequestTimeout, RetryRequest
+    from ..store import MemoryStore
+    from ..transport.broker import Broker
+    from ..transport.inproc import InProcTransport
+    from ..transport.mqtt_codec import encode_result_payload
+
+    clock = FakeClock()
+    broker = Broker()
+    shared = MemoryStore(shared=True)
+
+    def make(rid: str) -> DpowServer:
+        config = ServerConfig(
+            base_difficulty=EASY_DIFFICULTY,
+            throttle=1000.0,
+            heartbeat_interval=3600.0,
+            statistics_interval=3600.0,
+            work_republish_interval=2.0,
+            fleet=False,
+            replicas=2,
+            replica_id=rid,
+            replica_ttl=2.0,
+            replica_heartbeat_interval=3600.0,  # cadence driven by poll()
+        )
+        return DpowServer(
+            config,
+            PerturbingStore(shared, perturber),
+            PerturbingTransport(
+                InProcTransport(broker, client_id=f"server-{rid}"), perturber
+            ),
+            clock=clock,
+        )
+
+    a, b = make("ra"), make("rb")
+    store = PerturbingStore(shared, perturber)
+    await store.hset(
+        "service:svc",
+        {"api_key": hash_key("secret"), "public": "N",
+         "display": "svc", "website": "", "precache": "0", "ondemand": "0"},
+    )
+    await store.sadd("services", "svc")
+    takeovers = obs.get_registry().counter("dpow_replica_takeovers_total")
+    takeovers_before = takeovers.value()
+    payout = _payout()
+    try:
+        for s in (a, b):
+            await s.setup()
+            s.start_loops()
+        for s in (a, b):
+            await s.replica.poll()
+        await _settle()
+        # a hash the ring assigns to rb: the request lands on ra and is
+        # forwarded to (and journaled by) the owner
+        i = 0
+        while True:
+            h = _scenario_hash(perturber.seed * 1009 + i, "takeover")
+            if owner_of(h, ["ra", "rb"]) == "rb":
+                break
+            i += 1
+        req = asyncio.ensure_future(a.service_handler(
+            {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+        ))
+        for _ in range(3000):
+            if any(rh == h for rh, _ in await fence.read_dispatches(shared, "rb")):
+                break
+            await asyncio.sleep(0)
+        else:
+            raise SanitizerFailure(
+                "forwarded dispatch never reached the owner's journal"
+            )
+        # SIGKILL the owner mid-flight; the survivor absorbs the final
+        # heartbeat, then a full silent ttl passes
+        await b.crash()
+        await a.replica.poll()
+        await clock.advance(2.5)
+        # THE RACE: the adoption pass and the dead owner's late worker
+        # result run concurrently — the perturber's parks/yields slide the
+        # result delivery into seed-chosen points of the adopt path
+        work = solve(h, EASY_DIFFICULTY)
+
+        async def late_result() -> None:
+            for _ in range(perturber.rng.randint(0, 40)):
+                await asyncio.sleep(0)
+            await a.client_result_handler(
+                "result/ondemand", encode_result_payload(h, work, payout)
+            )
+
+        await asyncio.gather(a.replica.poll(), late_result())
+        for spin in range(3000):
+            if req.done():
+                break
+            if await store.get(f"block:{h}") == WORK_PENDING:
+                # the adopted re-publish is live again: answer it
+                await a.client_result_handler(
+                    "result/ondemand", encode_result_payload(h, work, payout)
+                )
+            await asyncio.sleep(0)
+        else:
+            stored = await store.get(f"block:{h}")
+            raise SanitizerFailure(
+                f"surviving waiter stranded after the owner died "
+                f"(store holds {stored!r})"
+            )
+        result = await asyncio.gather(req, return_exceptions=True)
+        r = result[0]
+        if r != {"work": work, "hash": h} and not isinstance(
+            r, (RetryRequest, RequestTimeout)
+        ):
+            raise SanitizerFailure(f"surviving waiter ended wrong: {r!r}")
+        # at most ONE adopter took the death event — a second adoption
+        # would re-publish (double-dispatch) a hash someone already owns
+        adopted = takeovers.value() - takeovers_before
+        if adopted > 1:
+            raise SanitizerFailure(
+                f"death event adopted {adopted} times (double-dispatch)"
+            )
+        await _settle(120)
+        if await fence.read_dispatches(shared, "rb"):
+            raise SanitizerFailure(
+                "the dead owner's journal did not drain after adoption"
+            )
+        _check_teardown(a)
+        if a._forward_origins or a._adopted_orphan:
+            raise SanitizerFailure(
+                "replica relay/orphan tables leaked past the teardown"
+            )
+    finally:
+        await a.close()
+        await b.close()
+
+
 SCENARIOS: Dict[str, Callable] = {
     "coalesce": scenario_coalesce,
     "fleet_recover": scenario_fleet_recover,
+    "takeover": scenario_takeover,
 }
 
 

@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from .core import Finding, Project
 
 SPEC_DOC = "specification.md"
-ROOTS = ("work", "result", "cancel", "client", "fleet")
+ROOTS = ("work", "result", "cancel", "client", "fleet", "replica")
 BARE_TOPICS = {"heartbeat", "statistics"}
 
 _SEGMENT_RE = re.compile(r"^[A-Za-z0-9_.+-]+$")
@@ -360,6 +360,10 @@ def spec_frames(project: Project) -> Dict[str, Tuple[int, str, int]]:
 PRINCIPALS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("server/", ("dpowserver",)),
     ("fleet/", ("dpowserver",)),
+    # orchestrator replicas connect as dpowserver too: the replica plane
+    # (replica/dispatch/{id} forwards, result/{id}/{type} relays) is
+    # server↔server traffic (docs/replication.md)
+    ("replica/", ("dpowserver",)),
     ("client/", ("client",)),
     ("scripts/check_latency", ("dpowinterface",)),
 )

@@ -30,6 +30,7 @@ gauge tracks how many workers the last dispatch set racing (1 shard = 1).
 
 from __future__ import annotations
 
+import asyncio
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs
@@ -63,6 +64,7 @@ class FleetCoordinator:
         clock,
         enabled: bool = True,
         codec_v1: bool = True,
+        lane_flush: bool = False,
     ):
         self.registry = registry
         self.planner = planner
@@ -77,6 +79,21 @@ class FleetCoordinator:
         # audience; legacy racers must keep parsing byte-for-byte). False
         # (--codec v0) pins every publish to the legacy grammar.
         self.codec_v1 = codec_v1
+        # Cross-dispatch micro-batching (--lane_flush, ROADMAP item 5
+        # leftover): initial dispatches buffer their lane items for ONE
+        # event-loop tick (call_soon flush) so DIFFERENT hashes dispatched
+        # in the same tick share a single WORK_BATCH frame. Costs one tick
+        # of publish latency per dispatch; only v1 lanes buffer (a v0 lane
+        # publishes per item anyway), and the supervisor's republish path
+        # never defers — its re-cover bookkeeping requires the lane
+        # publish to have LANDED before a shard is recorded as moved.
+        self.lane_flush = lane_flush
+        self._lane_buf: Dict[Tuple[str, str], list] = {}
+        self._flush_scheduled = False
+        # Retained flush tasks (dpowlint DPOW301): the loop holds only
+        # weak refs, so an unretained ensure_future is GC-cancellable
+        # mid-publish.
+        self._flush_tasks: set = set()
         reg = obs.get_registry()
         self._m_dispatch = reg.counter(
             "dpow_fleet_dispatch_total",
@@ -106,11 +123,23 @@ class FleetCoordinator:
         work_type: str,
         worker_id: str,
         items: List[Tuple[str, int, Optional[str], Optional[tuple]]],
+        defer: bool = False,
     ) -> None:
         """Everything one worker gets this flush, on its private lane: ONE
         v1 frame (batched past one item) for a v1-capable peer, else one
         legacy ASCII publish per item. A v1 encode failure (malformed
-        field) falls back to v0 rather than dropping the dispatch."""
+        field) falls back to v0 rather than dropping the dispatch.
+
+        ``defer=True`` (initial dispatches under --lane_flush) parks the
+        items in the per-lane tick buffer instead: a call_soon-scheduled
+        flush packs everything the lane accumulated this event-loop tick —
+        across DIFFERENT dispatches — into one WORK_BATCH frame."""
+        if defer and self.lane_flush and self._peer_v1(worker_id):
+            self._lane_buf.setdefault((work_type, worker_id), []).extend(items)
+            if not self._flush_scheduled:
+                self._flush_scheduled = True
+                asyncio.get_running_loop().call_soon(self._flush_lanes)
+            return
         topic = work_topic(work_type, worker_id)
         if self._peer_v1(worker_id):
             try:
@@ -135,6 +164,30 @@ class FleetCoordinator:
             )
             wire.count_encoded("v0", "work")
 
+    def _flush_lanes(self) -> None:
+        """call_soon callback: drain the tick buffer in one retained task.
+        Runs at most once per scheduling tick — every dispatch buffered
+        before the loop reached this callback rides the same flush."""
+        self._flush_scheduled = False
+        buf, self._lane_buf = self._lane_buf, {}
+        if not buf:
+            return
+        task = asyncio.ensure_future(self._drain_lanes(buf))
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
+
+    async def _drain_lanes(
+        self, buf: Dict[Tuple[str, str], list]
+    ) -> None:
+        for (work_type, worker_id), items in buf.items():
+            try:
+                await self._publish_lane(work_type, worker_id, items)
+            except Exception:
+                logger.exception(
+                    "lane flush to %s failed (%d item(s) dropped; the "
+                    "supervisor republish heals them)", worker_id, len(items),
+                )
+
     async def _publish_assignments(
         self,
         block_hash: str,
@@ -156,6 +209,7 @@ class FleetCoordinator:
                     (block_hash, difficulty, trace_id, (a.start, a.length))
                     for a in shards
                 ],
+                defer=True,
             )
 
     async def _publish_broadcast(

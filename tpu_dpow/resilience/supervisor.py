@@ -63,11 +63,17 @@ class DispatchSupervisor:
         republish: RepublishFn,
         hedge_after: int = 2,
         clock: Optional[Clock] = None,
+        on_abandon: Optional[Callable[[str], None]] = None,
     ):
         self.grace = grace
         self.hedge_after = max(hedge_after, 1)
         self.republish = republish
         self.clock = clock or SystemClock()
+        # Fired once (sync) when a dispatch's deadline expires with the
+        # future unresolved. Waiterless dispatches — a replica's ADOPTED
+        # takeovers (tpu_dpow/replica/) — have no request coroutine whose
+        # teardown would ever reap them; this hook is their reaper.
+        self.on_abandon = on_abandon
         self._dispatches: Dict[str, _Dispatch] = {}
         reg = obs.get_registry()
         self._m_tracked = reg.gauge(
@@ -116,6 +122,12 @@ class DispatchSupervisor:
     def tracked(self, block_hash: str) -> bool:
         return block_hash in self._dispatches
 
+    def deadline_of(self, block_hash: str) -> Optional[float]:
+        """The latest waiter deadline under supervision (None when
+        untracked) — what a replica re-journals for its takeover record."""
+        d = self._dispatches.get(block_hash)
+        return d.deadline if d is not None else None
+
     def was_hedged(self, block_hash: str) -> bool:
         """Did this dispatch ever go out hedged? The winner's cancel must
         then fan out to the secondary work topic too, or the recruited
@@ -153,6 +165,13 @@ class DispatchSupervisor:
                         "dispatch %s outlived its deadline; re-dispatch stopped",
                         block_hash,
                     )
+                    if self.on_abandon is not None:
+                        try:
+                            self.on_abandon(block_hash)
+                        except Exception:
+                            logger.exception(
+                                "abandon callback failed for %s", block_hash
+                            )
                 continue
             if not d.published:
                 continue  # dispatcher still mid-publish; it will stamp

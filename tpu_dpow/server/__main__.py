@@ -32,6 +32,13 @@ async def amain(argv=None) -> None:
     config = parse_args(argv)
     logger = get_logger("tpu_dpow.server", file_path=config.log_file, debug=config.debug)
 
+    # Per-replica broker session id (docs/replication.md): MQTT sessions
+    # are keyed by client id, so two replicas sharing the literal "server"
+    # would steal each other's subscriptions and queued QoS-1 messages on
+    # every (re)connect. One process (replicas == 1) keeps the legacy id.
+    client_id = (
+        f"server-{config.replica_id}" if config.replicas > 1 else "server"
+    )
     broker_server = None
     if config.inproc_broker:
         broker = Broker(users=default_users())
@@ -42,10 +49,11 @@ async def amain(argv=None) -> None:
                                         port=u.port or 1883)
         await broker_server.start()
         transport = InProcTransport(
-            broker, username="dpowserver", password="dpowserver", client_id="server"
+            broker, username="dpowserver", password="dpowserver",
+            client_id=client_id,
         )
     else:
-        transport = transport_from_uri(config.transport_uri, client_id="server")
+        transport = transport_from_uri(config.transport_uri, client_id=client_id)
 
     store = get_store(config.store_uri)
     server = DpowServer(config, store, transport)

@@ -25,8 +25,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import traceback
-from typing import Dict, Optional
+from typing import Dict, Optional, Set
 
 from .. import obs
 from ..fleet import (
@@ -37,9 +38,10 @@ from ..fleet import (
     WorkerRegistry,
 )
 from ..models import DifficultyModel, WorkType
+from ..replica import ReplicaCoordinator, StaleEpoch, dispatch_topic, result_lane
 from ..resilience import DispatchSupervisor, SystemClock
-from ..sched import AdmissionController
-from ..store import MemoryStore, Store, atomic_write
+from ..sched import AdmissionController, Busy
+from ..store import DegradedStore, MemoryStore, Store, atomic_write
 from ..transport import Message, QOS_0, QOS_1, Transport
 from ..transport import wire
 from ..utils import nanocrypto as nc
@@ -98,6 +100,7 @@ class DpowServer:
             hedge_after=config.hedge_after,
             republish=self._republish_work,
             clock=self.clock,
+            on_abandon=self._dispatch_abandoned,
         )
         # Per-hash: serializes the dispatcher's difficulty-entry write with
         # concurrent raisers for the SAME hash, so interleaved store writes
@@ -160,7 +163,59 @@ class DpowServer:
             clock=self.clock,
             enabled=config.fleet,
             codec_v1=config.codec != "v0",
+            lane_flush=config.lane_flush,
         )
+        # Replication (tpu_dpow/replica/, docs/replication.md): with
+        # --replicas > 1 this process is ONE member of a ring of
+        # near-stateless orchestrator replicas over the SHARED store. It
+        # owns a hash-partitioned slice of request space (rendezvous
+        # ring), forwards non-owned on-demand dispatches to their owner
+        # (cross-replica coalescing), journals every local dispatch so a
+        # peer can adopt it if this process dies, and adopts dead peers'
+        # journals in turn (leaderless takeover, epoch-fenced against
+        # zombies).
+        self.replica: Optional[ReplicaCoordinator] = None
+        if config.replicas > 1:
+            inner = store
+            while isinstance(inner, DegradedStore):
+                inner = inner.primary
+            if isinstance(inner, MemoryStore) and not getattr(inner, "shared", False):
+                raise ValueError(
+                    "--replicas > 1 requires a SHARED store, but the "
+                    "configured store is a per-process memory:// store: "
+                    "each replica would keep its own quota ledger, fleet "
+                    "registry, and replica membership, so the ring would "
+                    "never see its peers and the takeover journal could "
+                    "not survive a crash. Point every replica at one "
+                    "--store_uri sqlite:///path.db file, redis://host, or "
+                    "degraded+ over either; embedded in-process "
+                    "topologies (tests, benchmarks) may instead hand the "
+                    "same MemoryStore(shared=True) instance to every "
+                    "replica."
+                )
+            self.replica = ReplicaCoordinator(
+                store,
+                replica_id=config.replica_id or f"r{os.getpid()}",
+                clock=self.clock,
+                ttl=config.replica_ttl,
+                heartbeat_interval=config.replica_heartbeat_interval,
+                adopt=self._adopt_dispatch,
+            )
+        # Hashes whose work_futures entry is a FORWARD PROXY (the ring
+        # owner dispatches; the shared result plane resolves it here) and
+        # hashes this replica journaled for takeover. Both live and die
+        # with the work_futures entry (_drop_dispatch_state).
+        self._forwarded: Set[str] = set()
+        self._journaled: Set[str] = set()
+        # Peer replicas that forwarded each in-flight hash here: the
+        # eventual result is RELAYED to their addressed lanes
+        # (result/{origin}/{type}, QoS 1) so a forwarder that missed the
+        # QoS-0 worker result still resolves its proxy promptly.
+        self._forward_origins: Dict[str, Set[str]] = {}
+        # Adopted takeovers with NO local waiter: no request coroutine
+        # will ever tear them down — the supervisor's abandon hook (at
+        # deadline) or _maybe_finish_adopted (on resolve) is their reaper.
+        self._adopted_orphan: Set[str] = set()
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
         self.work_republished = 0  # healed lost publishes (observability)
@@ -169,6 +224,7 @@ class DpowServer:
         # refs to tasks, so an unretained ensure_future is GC-cancellable
         # mid-write (dpowlint DPOW301) — retained here, reaped on done.
         self._bg_tasks: set = set()
+        self._crashed = False
         self._started = False
         # Metrics (tpu_dpow.obs): the queue-depth / latency / outcome
         # signals the reference's two Redis counters cannot answer. Family
@@ -228,6 +284,24 @@ class DpowServer:
             # Rehydrate fleet capabilities (learned hashrates) from the
             # store; liveness restarts with one ttl of announce grace.
             await self.fleet_registry.load()
+        if self.replica is not None:
+            # Join the ring (fresh epoch) and open this replica's
+            # forwarded-dispatch lane. QoS 1: a forwarded request must
+            # survive an owner mid-reconnect, or the forwarder strands to
+            # its timeout for nothing.
+            await self.replica.start()
+            await self.transport.subscribe(
+                dispatch_topic(self.replica.replica_id), qos=QOS_1
+            )
+            # Our addressed result-relay lane needs its OWN QoS-1
+            # subscription: relays are published QoS 1, but the broker
+            # delivers at min(publish, subscription) and the shared
+            # result/# subscription above is QoS 0 — without this a relay
+            # sent while we are mid-reconnect is dropped instead of queued,
+            # stranding the proxy until its store-fallback timeout.
+            await self.transport.subscribe(
+                f"result/{self.replica.replica_id}/#", qos=QOS_1
+            )
         self._started = True
 
     def start_loops(self) -> None:
@@ -245,13 +319,25 @@ class DpowServer:
         )
         if self.config.fleet:
             self._tasks.append(asyncio.ensure_future(self._fleet_poll_loop()))
+        if self.replica is not None:
+            self._tasks.append(asyncio.ensure_future(self.replica.run()))
         if self.config.checkpoint_path and isinstance(self.store, MemoryStore):
             self._tasks.append(asyncio.ensure_future(self._checkpoint_loop()))
 
-    def _spawn(self, coro) -> "asyncio.Task":
+    def _spawn(self, coro) -> "asyncio.Future":
         """Launch a fire-and-forget store write WITHOUT losing the task:
         the loop's task set is weak, so a dropped ensure_future result can
         be garbage-collected — and cancelled — mid-write."""
+        if self._crashed:
+            # crash() fidelity: a SIGKILLed process writes no goodbyes.
+            # Cancelled tasks still run their finallys (asyncio offers no
+            # way around that), so the journal/frontier teardown writes
+            # they try to spawn are refused here — the shared store must
+            # keep exactly the state the dead process left behind.
+            coro.close()
+            done = asyncio.get_event_loop().create_future()
+            done.set_result(None)
+            return done
         task = asyncio.ensure_future(coro)
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_tasks.discard)
@@ -291,8 +377,39 @@ class DpowServer:
                 )
             except Exception as e:
                 logger.warning("final checkpoint failed: %s", e)
+        if self.replica is not None:
+            # Clean leave: drop the member record so peers rebalance now
+            # instead of waiting out the ttl. Best-effort — a fenced
+            # zombie has nothing left to remove.
+            try:
+                await self.replica.stop()
+            except Exception as e:
+                logger.warning("replica leave failed: %s", e)
         await self.transport.close()
         await self.store.close()
+
+    async def crash(self) -> None:
+        """Chaos seam: die with NO teardown courtesy — loops cancelled,
+        transport dropped, store state (replica membership, heartbeats,
+        takeover journal) left in place exactly as a SIGKILL would leave
+        it. The replica chaos tests and benchmarks/replicas.py kill one
+        ring member this way to exercise the takeover path; close() is
+        the clean exit."""
+        self._started = False
+        # Sever the outside world BEFORE cancelling: the cancelled tasks'
+        # finally blocks would otherwise run their graceful teardown —
+        # journal forgets, cancel frames — against the shared store and
+        # broker, which a real SIGKILL never gets to do.
+        self._crashed = True
+        await self.transport.close()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        draining, self._bg_tasks = set(self._bg_tasks), set()
+        for t in draining:
+            t.cancel()
+        await asyncio.gather(*draining, return_exceptions=True)
 
     # ------------------------------------------------------------------
     # background loops
@@ -306,6 +423,11 @@ class DpowServer:
                     await self.client_result_handler(msg.topic, msg.payload)
                 elif msg.topic == ANNOUNCE_TOPIC and self.config.fleet:
                     await self.fleet.on_announce(msg.payload)
+                elif (
+                    self.replica is not None
+                    and msg.topic == dispatch_topic(self.replica.replica_id)
+                ):
+                    await self._replica_forward_handler(msg.payload)
             except Exception:
                 logger.error("result handling failed:\n%s", traceback.format_exc())
 
@@ -419,6 +541,407 @@ class DpowServer:
                 logger.warning("checkpoint failed: %s", e)
 
     # ------------------------------------------------------------------
+    # replica plane (tpu_dpow/replica/, docs/replication.md)
+    # ------------------------------------------------------------------
+
+    async def _send_forward(
+        self, owner: str, block_hash: str, difficulty: int, deadline: float
+    ) -> None:
+        """Hand a dispatch to its ring owner on the owner's addressed lane
+        (replica/dispatch/{owner}, QoS 1 — a forwarded request must survive
+        the owner mid-reconnect, or the forwarder strands for nothing).
+        The frame carries our epoch so a zombie forwarder is refused."""
+        payload = json.dumps({
+            "v": 1,
+            "hash": block_hash,
+            "difficulty": difficulty,
+            "from": self.replica.replica_id,
+            "epoch": self.replica.registry.epoch,
+            "budget": max(deadline - self.clock.time(), 0.001),
+        })
+        await self.transport.publish(dispatch_topic(owner), payload, qos=QOS_1)
+
+    async def _replica_forward_handler(self, payload: str) -> None:
+        """Owner side of cross-replica forwarding: a peer determined WE own
+        this hash. Dispatch it here — through the normal admission/coalesce
+        machinery, as a waiterless pseudo-request — and relay the result to
+        the forwarder's lane when it lands."""
+        try:
+            data = json.loads(payload)
+        except ValueError:
+            return
+        if not isinstance(data, dict):
+            return
+        try:
+            block_hash = nc.validate_block_hash(str(data["hash"]))
+            difficulty = int(data["difficulty"])
+            origin = str(data["from"])
+            epoch = int(data.get("epoch", 0))
+            budget = float(data.get("budget", self.config.default_timeout))
+        except (KeyError, TypeError, ValueError, nc.InvalidBlockHash):
+            return
+        if not await self.replica.publish_allowed(origin, epoch, "forward"):
+            return
+        budget = min(max(budget, 0.001), self.config.max_timeout)
+        available = await self.store.get(f"block:{block_hash}")
+        if available and available != WORK_PENDING:
+            strong = True
+            try:
+                strong = nc.work_value(block_hash, available) >= difficulty
+            except (nc.InvalidBlockHash, nc.InvalidWork, ValueError):
+                strong = False
+            if strong:
+                # Solved before the forward arrived (a precache hit, or a
+                # peer's dispatch): serve the forwarder straight from the
+                # store.
+                work_type = (
+                    await self.store.get(f"work-type:{block_hash}")
+                    or WorkType.PRECACHE.value
+                )
+                await self._relay_result_to(
+                    origin, block_hash, available, work_type
+                )
+                return
+            # Solved BELOW the forwarded target (a base-difficulty
+            # precache or weaker peer dispatch won while the forward was
+            # in flight): relaying it would bounce in the forwarder's
+            # final validation. Reset the frontier so the dispatch below
+            # re-targets at the forwarded difficulty (the entry-path
+            # weak-precache idiom).
+            await self.store.set(
+                f"block:{block_hash}", WORK_PENDING,
+                expire=self.config.block_expiry,
+            )
+            await self.store.delete(f"block-lock:{block_hash}")
+        self._forward_origins.setdefault(block_hash, set()).add(origin)
+        if block_hash in self._journaled:
+            # The dispatch is already journaled without this origin; an
+            # adopter must know whom to relay to if we die now.
+            self._spawn(self._rejournal(block_hash))
+        self._spawn(self._serve_forwarded(block_hash, difficulty, budget, origin))
+
+    async def _serve_forwarded(
+        self, block_hash: str, difficulty: int, budget: float, origin: str
+    ) -> None:
+        """Drive a forwarded dispatch as a local waiter: it holds the
+        admission slot, coalesces with concurrent local requests for the
+        same hash, extends supervision to the forwarder's budget, and tears
+        down by the normal refcount. The result relay rides the winner
+        path (_relay_origins), not this coroutine — a relay must fire even
+        when a LOCAL request's dispatch resolves the hash first."""
+        try:
+            await self._dispatch_ondemand(
+                block_hash, None, difficulty, budget,
+                service=f"replica:{origin}", allow_forward=False,
+            )
+        except (RequestTimeout, RetryRequest, Busy):
+            # Clean abort: the forwarder's own deadline fallback (store
+            # check at timeout) is the remaining answer path. A forward
+            # shed BEFORE any dispatch state existed (admission Busy)
+            # leaves no teardown to pop the origin set later — drop it
+            # here or every shed forwarded hash leaks an entry (and a
+            # later unrelated dispatch of the hash would relay to it).
+            if block_hash not in self.work_futures:
+                self._forward_origins.pop(block_hash, None)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.error(
+                "forwarded dispatch for %s failed:\n%s",
+                block_hash, traceback.format_exc(),
+            )
+            # Same leak guard as the clean-abort branch: a failure before
+            # any dispatch state existed (e.g. store error inside
+            # admission) leaves no teardown to pop the origin set.
+            if block_hash not in self.work_futures:
+                self._forward_origins.pop(block_hash, None)
+
+    async def _relay_result_to(
+        self, origin: str, block_hash: str, work: str, work_type: str
+    ) -> None:
+        """One addressed result relay: result/{origin}/{type}, QoS 1,
+        stamped with our epoch (receivers fence zombies)."""
+        payload = json.dumps({
+            "v": 1,
+            "hash": block_hash,
+            "work": work,
+            "type": work_type,
+            "from": self.replica.replica_id,
+            "epoch": self.replica.registry.epoch,
+        })
+        try:
+            await self.transport.publish(
+                result_lane(origin, work_type), payload, qos=QOS_1
+            )
+            self.replica.count_relay("sent")
+        except Exception as e:
+            logger.warning("result relay to %s failed: %s", origin, e)
+
+    async def _recorded_difficulty(self, block_hash: str) -> int:
+        """The target on record for an in-flight hash: the store's
+        `block-difficulty:` row (authoritative across replicas — initial
+        raised dispatches and re-targets bump it), falling back to the
+        locally dispatched target, then the base. The one definition every
+        resolve/validate site shares, so target resolution cannot diverge
+        between them."""
+        difficulty_hex = await self.store.get(f"block-difficulty:{block_hash}")
+        if difficulty_hex:
+            try:
+                return int(difficulty_hex, 16)
+            except ValueError:
+                pass
+        return self._dispatched_difficulty.get(
+            block_hash, self.config.base_difficulty
+        )
+
+    async def _store_work_strong(self, block_hash: str, work: str) -> bool:
+        """Stored work answers local waiters only when it meets the
+        RECORDED target for the hash: weaker work (a base-difficulty
+        precache winning the election under a raised re-target) bounces in
+        the waiter's final validation, turning a late answer into an error
+        reply — the weak-precache class the local resolve sites guard
+        against (PR 8)."""
+        difficulty = await self._recorded_difficulty(block_hash)
+        try:
+            return nc.work_value(block_hash, work) >= difficulty
+        except (nc.InvalidBlockHash, nc.InvalidWork, ValueError):
+            return False
+
+    async def _relay_origins(
+        self, block_hash: str, work: str, work_type: str
+    ) -> None:
+        """Relay a resolved hash to every replica that forwarded it here.
+        Pops the origin set: at most one site relays per dispatch."""
+        if self.replica is None:
+            return
+        origins = self._forward_origins.pop(block_hash, None)
+        if not origins:
+            return
+        for origin in sorted(origins):
+            await self._relay_result_to(origin, block_hash, work, work_type)
+
+    async def _handle_result_relay(self, content: str) -> None:
+        """Forwarder side of the relay: resolve the local proxy future from
+        the store (the relayer stored the work before relaying). Zombie
+        relays — an adopted replica's stale publish — are fenced."""
+        try:
+            data = json.loads(content)
+        except ValueError:
+            return
+        if not isinstance(data, dict):
+            return
+        try:
+            block_hash = nc.validate_block_hash(str(data["hash"]))
+            sender = str(data.get("from", ""))
+            epoch = int(data.get("epoch", 0))
+            work = str(data.get("work", ""))
+        except (KeyError, TypeError, ValueError, nc.InvalidBlockHash):
+            return
+        if not await self.replica.publish_allowed(sender, epoch, "relay"):
+            return
+        fut = self.work_futures.get(block_hash)
+        if fut is None or fut.done():
+            self.replica.count_relay("stale")
+            return
+        available = await self.store.get(f"block:{block_hash}")
+        if (
+            available
+            and available != WORK_PENDING
+            and await self._store_work_strong(block_hash, available)
+        ):
+            # The relayer's store write is the authority (it won the
+            # election); the payload's work is a convenience copy.
+            if self.work_futures.get(block_hash) is fut and not fut.done():
+                fut.set_result(available)
+                self.replica.count_relay("served")
+            self._maybe_finish_adopted(block_hash)
+            return
+        # Store not settled yet (relay raced the shared store) — or it
+        # settled WEAKER than our recorded target (a base-difficulty
+        # precache under a raised re-target), which must not resolve the
+        # proxy: accept the payload's work only if it validates at our
+        # recorded target.
+        difficulty = await self._recorded_difficulty(block_hash)
+        try:
+            nc.validate_work(block_hash, work, difficulty)
+        except (nc.InvalidWork, nc.InvalidBlockHash):
+            self.replica.count_relay("invalid")
+            return
+        if self.work_futures.get(block_hash) is fut and not fut.done():
+            fut.set_result(work)
+            self.replica.count_relay("served")
+        self._maybe_finish_adopted(block_hash)
+
+    async def _rejournal(self, block_hash: str) -> None:
+        """Refresh this dispatch's takeover record (new origin attached, or
+        a later waiter extended the deadline). Fire-and-forget: once we are
+        fenced the record belongs to the adopter."""
+        if self.replica is None or block_hash not in self._journaled:
+            return
+        deadline = self.supervisor.deadline_of(block_hash)
+        if deadline is None:
+            return
+        try:
+            await self.replica.journal_dispatch(
+                block_hash,
+                self._dispatched_difficulty.get(
+                    block_hash, self.config.base_difficulty
+                ),
+                WorkType.ONDEMAND.value,
+                deadline,
+                origins=self._forward_origins.get(block_hash, ()),
+            )
+        except StaleEpoch:
+            pass
+
+    async def _adopt_dispatch(
+        self, block_hash: str, record: dict, dead_id: str
+    ) -> bool:
+        """Takeover of ONE journaled dispatch from a dead peer (called by
+        the ReplicaCoordinator once it won the adoption claim and fenced
+        the dead epoch). Serve-or-clean-abort: re-arm supervision and
+        re-publish if the work is still wanted, relay late if it already
+        resolved, drop cleanly if the frontier moved on."""
+        origins = [
+            o for o in record.get("origins", ()) if isinstance(o, str) and o
+        ]
+        try:
+            difficulty = int(record.get("difficulty") or self.config.base_difficulty)
+        except (TypeError, ValueError):
+            difficulty = self.config.base_difficulty
+        work_type = str(record.get("work_type") or WorkType.ONDEMAND.value)
+        if work_type not in (WorkType.ONDEMAND.value, WorkType.PRECACHE.value):
+            work_type = WorkType.ONDEMAND.value
+        available = await self.store.get(f"block:{block_hash}")
+        if available is None:
+            return True  # frontier moved on / expired: nothing left to serve
+        if available != WORK_PENDING:
+            # Resolved while the owner was dying: late service is all that
+            # is left — relay straight to the forwarders.
+            for origin in origins:
+                await self._relay_result_to(
+                    origin, block_hash, available, work_type
+                )
+            return True
+        now = self.clock.time()
+        deadline = ReplicaCoordinator.adopted_deadline(record, now)
+        if deadline <= now:
+            return True  # budget exhausted before adoption: clean abort
+        # A raised re-target may have outbid the journaled difficulty; the
+        # result handler validates against the store, so the re-publish
+        # must not fall below it.
+        difficulty = max(
+            difficulty, await self._recorded_difficulty(block_hash)
+        )
+        if origins:
+            self._forward_origins.setdefault(block_hash, set()).update(origins)
+        existing = self.work_futures.get(block_hash)
+        if existing is not None:
+            # Already tracked here — typically OUR forward proxy to the
+            # dead owner. From adoption on this replica IS the owner:
+            # supervise to the journaled budget and re-publish (the dead
+            # owner's publish may never have fired). No cleanup guard: the
+            # proxy's waiters own its teardown, and on failure the journal
+            # record stays for the next poll's retry.
+            self._forwarded.discard(block_hash)
+            difficulty = max(
+                difficulty,
+                self._dispatched_difficulty.get(block_hash, difficulty),
+            )
+            self._dispatched_difficulty[block_hash] = difficulty
+            await self._arm_adopted(
+                block_hash, existing, difficulty, work_type, deadline, origins
+            )
+            return True
+        fut = asyncio.get_running_loop().create_future()
+        self.work_futures[block_hash] = fut
+        self._dispatched_difficulty[block_hash] = difficulty
+        self._adopted_orphan.add(block_hash)
+        self._m_dispatches.set(len(self.work_futures))
+        try:
+            await self._arm_adopted(
+                block_hash, fut, difficulty, work_type, deadline, origins
+            )
+        except BaseException:
+            # A failed adoption must not strand a dead future; the journal
+            # record stays (the coordinator only drops it on success), so
+            # the next poll retries.
+            if self.work_futures.get(block_hash) is fut:
+                self._drop_dispatch_state(block_hash)
+            if not fut.done():
+                fut.cancel()
+            raise
+        return True
+
+    async def _arm_adopted(
+        self,
+        block_hash: str,
+        fut: asyncio.Future,
+        difficulty: int,
+        work_type: str,
+        deadline: float,
+        origins,
+    ) -> None:
+        """Shared tail of both _adopt_dispatch branches: supervise to the
+        journaled budget, re-journal under OUR id — without it the adopted
+        dispatch is in no journal at all (the coordinator deletes the dead
+        owner's record on success), so a SECOND replica failure would make
+        it unadoptable — then re-publish. Both awaits are guarded against
+        the served-while-journaling window: the dead owner's late result
+        can resolve the dispatch and tear its state down while either
+        suspension is parked."""
+        self.supervisor.track(block_hash, deadline)
+        await self.replica.journal_dispatch(
+            block_hash, difficulty, work_type, deadline,
+            origins=[o for o in origins if o != self.replica.replica_id],
+        )
+        if self.work_futures.get(block_hash) is not fut:
+            # Teardown ran while the journal write was suspended: the
+            # dispatch was SERVED. Teardown could not forget the record we
+            # just wrote (we had not marked _journaled yet) — drop it
+            # here, unless a brand-new dispatch of the same hash already
+            # journaled itself and owns the key now.
+            if block_hash not in self._journaled:
+                await self.replica.forget_dispatch(block_hash)
+            return
+        self._journaled.add(block_hash)
+        if fut.done():
+            return  # resolved while journaling: nothing to re-publish
+        await self.fleet.publish_work(
+            block_hash, difficulty, work_type, self._tracer.id_for(block_hash),
+        )
+        self.supervisor.dispatched(block_hash)
+
+    def _dispatch_abandoned(self, block_hash: str) -> None:
+        """Supervisor abandon hook: reap an adopted, waiterless dispatch
+        whose budget expired unresolved (clean abort — the zombie's waiters
+        died with it; nothing is owed an answer any more)."""
+        if block_hash not in self._adopted_orphan:
+            return
+        self._adopted_orphan.discard(block_hash)
+        if self._future_waiters.get(block_hash):
+            return  # a local request attached meanwhile: its refcount owns teardown
+        fut = self.work_futures.get(block_hash)
+        if fut is not None:
+            self._drop_dispatch_state(block_hash)
+            if not fut.done():
+                fut.cancel()
+
+    def _maybe_finish_adopted(self, block_hash: str) -> None:
+        """Resolve-path reaper for adopted, waiterless dispatches: the
+        moment their future resolves there is nothing left to wait for."""
+        if block_hash not in self._adopted_orphan:
+            return
+        fut = self.work_futures.get(block_hash)
+        if (
+            fut is not None
+            and fut.done()
+            and not self._future_waiters.get(block_hash)
+        ):
+            self._adopted_orphan.discard(block_hash)
+            self._drop_dispatch_state(block_hash)
+
+    # ------------------------------------------------------------------
     # statistics (reference redis_db.py:25-52 aggregation)
     # ------------------------------------------------------------------
 
@@ -476,6 +999,21 @@ class DpowServer:
         )
 
     async def client_result_handler(self, topic: str, content: str) -> None:
+        if self.replica is not None:
+            # Replica result-lane routing (docs/replication.md): a
+            # three-segment topic result/{replica}/{type} is ADDRESSED.
+            # Our own lane and the lanes of dead peers we adopted are
+            # served here; a live peer's lane is its own business (it
+            # hears the same publish on its shared subscription).
+            segs = topic.split("/")
+            if len(segs) >= 3:
+                if not self.replica.serves_lane(segs[1]):
+                    return
+                # Addressed lanes carry JSON relay frames (peer→peer);
+                # legacy worker payloads never start with '{'.
+                if content.lstrip().startswith("{"):
+                    await self._handle_result_relay(content)
+                    return
         try:
             # Version-routed (transport/wire.py): a v1-capable worker
             # answers a binary dispatch with a binary RESULT frame — fixed
@@ -488,13 +1026,43 @@ class DpowServer:
         # Work still wanted? (hash deleted once its frontier moved on)
         available = await self.store.get(f"block:{block_hash}")
         if not available or available != WORK_PENDING:
+            if (
+                self.replica is not None
+                and available
+                and available != WORK_PENDING
+            ):
+                # Replicated: a PEER already elected the winner and stored
+                # the work while our local waiters (a forward proxy, or a
+                # concurrent dispatch) still hold an unresolved future.
+                # Resolve it from the store now instead of leaving them to
+                # the timeout-path store fallback.
+                # Type read FIRST: resolving the future wakes the waiter,
+                # whose teardown pops _forward_origins — an await between
+                # set_result and _relay_origins would let that run first
+                # and silently skip the relay (every other resolve site
+                # keeps set_result → _relay_origins await-free).
+                stored_type = (
+                    await self.store.get(f"work-type:{block_hash}")
+                    or WorkType.PRECACHE.value
+                )
+                # Only at the recorded target: stored work weaker than a
+                # raised re-target must not resolve waiters (it bounces in
+                # final validation) — they recover via their own
+                # timeout-path frontier reset, as on the non-replica path.
+                if await self._store_work_strong(block_hash, available):
+                    fut = self.work_futures.get(block_hash)
+                    if fut is not None and not fut.done():
+                        fut.set_result(available)
+                    await self._relay_origins(
+                        block_hash, available, stored_type
+                    )
+                    self._maybe_finish_adopted(block_hash)
             self._m_results.inc(1, "stale")
             return
 
         work_type = await self.store.get(f"work-type:{block_hash}") or WorkType.PRECACHE.value
 
-        difficulty_hex = await self.store.get(f"block-difficulty:{block_hash}")
-        difficulty = int(difficulty_hex, 16) if difficulty_hex else self.config.base_difficulty
+        difficulty = await self._recorded_difficulty(block_hash)
         try:
             nc.validate_work(block_hash, work, difficulty)
         except (nc.InvalidWork, nc.InvalidBlockHash):
@@ -514,6 +1082,17 @@ class DpowServer:
         if not await self.store.setnx(
             f"block-lock:{block_hash}", "1", expire=self.config.winner_lock_expiry
         ):
+            if self.replica is not None:
+                # Every replica hears every shared-topic result; exactly
+                # ONE wins the store election and runs the side effects
+                # (cancel fan-out, credit). The losers still owe their
+                # local waiters an answer: this work validated at the
+                # current target above, so hand it over directly.
+                fut = self.work_futures.get(block_hash)
+                if fut is not None and not fut.done():
+                    fut.set_result(work)
+                await self._relay_origins(block_hash, work, work_type)
+                self._maybe_finish_adopted(block_hash)
             self._m_results.inc(1, "lost_election")
             return
 
@@ -544,6 +1123,13 @@ class DpowServer:
         future = self.work_futures.get(block_hash)
         if future is not None and not future.done():
             future.set_result(work)
+        if self.replica is not None:
+            # Forwarders (and, for adopted dispatches, the dead owner's
+            # forwarders from its journal) get the answer on their
+            # addressed lanes — before the cancel fan-out, so their
+            # waiting proxies resolve as early as possible.
+            await self._relay_origins(block_hash, work, work_type)
+            self._maybe_finish_adopted(block_hash)
 
         # Tell everyone else to stop burning lanes on this hash.
         await self.transport.publish(f"cancel/{work_type}", block_hash, qos=QOS_1)
@@ -695,6 +1281,16 @@ class DpowServer:
         ticket = self._dispatch_tickets.pop(block_hash, None)
         if ticket is not None:
             self.admission.release(ticket)
+        self._forwarded.discard(block_hash)
+        self._forward_origins.pop(block_hash, None)
+        self._adopted_orphan.discard(block_hash)
+        if block_hash in self._journaled:
+            # Fire-and-forget, like the counter writes: teardown is sync
+            # and the journal record is advisory once the dispatch is
+            # gone (an adopter finding a resolved hash just cleans up).
+            self._journaled.discard(block_hash)
+            if self.replica is not None:
+                self._spawn(self.replica.forget_dispatch(block_hash))
         self._m_dispatches.set(len(self.work_futures))
 
     async def _authenticate(self, data: dict) -> str:
@@ -848,6 +1444,7 @@ class DpowServer:
         timeout: float,
         service: str = "",
         over_quota: bool = False,
+        allow_forward: bool = True,
     ) -> str:
         created = None
         ticket = None
@@ -857,7 +1454,53 @@ class DpowServer:
         # that asked for 10 s must never wait ~20 (queue + work).
         deadline = self.clock.time() + timeout
         coalesced = False  # this request counts in dpow_coalesce_total once
+        forward_installed = False  # this request installed the forward proxy
         while block_hash not in self.work_futures:
+            if self.replica is not None and allow_forward:
+                # Ring routing (replica/ring.py): a hash owned by a LIVE
+                # peer is dispatched there — one admission slot, one
+                # publish, one supervisor for the whole ring — and a local
+                # PROXY future is installed for the shared result plane
+                # (every replica hears every result) or the owner's
+                # addressed relay to resolve. allow_forward=False on the
+                # owner side keeps a forwarded dispatch local even if the
+                # ring view shifted mid-flight: serving unpartitioned is
+                # always correct, a forward cycle never is.
+                owner = self.replica.route(block_hash)
+                if owner != self.replica.replica_id:
+                    proxy = asyncio.get_running_loop().create_future()
+                    self.work_futures[block_hash] = proxy
+                    self._forwarded.add(block_hash)
+                    self._dispatched_difficulty[block_hash] = difficulty
+                    self._m_dispatches.set(len(self.work_futures))
+                    self._tracer.mark_hash(block_hash, "queue")
+                    # Supervised like a local dispatch: if the owner dies
+                    # before its journal is adopted — or never dispatches —
+                    # the grace window expires and _republish_work publishes
+                    # the work from HERE (availability beats partitioning).
+                    self.supervisor.track(block_hash, deadline)
+                    try:
+                        await self.store.set(
+                            f"work-type:{block_hash}", WorkType.ONDEMAND.value,
+                            expire=self.config.block_expiry,
+                        )
+                        await self._send_forward(
+                            owner, block_hash, difficulty, deadline
+                        )
+                        self.supervisor.dispatched(block_hash)
+                        self._tracer.mark_hash(block_hash, "publish")
+                    except BaseException:
+                        # Same identity-guarded cleanup as the dispatcher
+                        # path: a failed forward must not strand a
+                        # never-resolved proxy for later requests.
+                        if self.work_futures.get(block_hash) is proxy:
+                            # dpowlint: disable=DPOW801 — side tables live and die with the work_futures entry; the identity guard above re-validates them after the awaits
+                            self._drop_dispatch_state(block_hash)
+                        if not proxy.done():
+                            proxy.cancel()
+                        raise
+                    forward_installed = True
+                    break
             gate = (
                 self._dispatch_gates.get(block_hash)
                 if self.config.coalesce else None
@@ -997,6 +1640,29 @@ class DpowServer:
                         )
                     await self.store.set(f"work-type:{block_hash}", WorkType.ONDEMAND.value,
                                          expire=self.config.block_expiry)
+                    if self.replica is not None:
+                        # Takeover journal (docs/replication.md): persist
+                        # the minimal record a peer needs to adopt this
+                        # dispatch BEFORE the publish — a crash between
+                        # journal and publish is healed by the adopter's
+                        # re-publish; the reverse order would strand the
+                        # waiters of an unjournaled in-flight dispatch.
+                        # StaleEpoch here means we are a ZOMBIE: a peer
+                        # already owns everything we believe is ours —
+                        # fail the dispatch instead of running it
+                        # unsupervised under a dead epoch (the poll loop
+                        # rejoins with a fresh epoch).
+                        try:
+                            await self.replica.journal_dispatch(
+                                block_hash, difficulty,
+                                WorkType.ONDEMAND.value, deadline,
+                                origins=self._forward_origins.get(
+                                    block_hash, ()
+                                ),
+                            )
+                        except StaleEpoch:
+                            raise RetryRequest()
+                        self._journaled.add(block_hash)
                     # Serialized with concurrent raisers (_raise_lock): a
                     # raiser that slipped in while this dispatcher was
                     # suspended in the store writes above has already bumped
@@ -1073,12 +1739,13 @@ class DpowServer:
                     gate.set_result(None)
             break
         timeout = max(deadline - self.clock.time(), 0.01)
-        if created is None and self.config.coalesce:
+        if created is None and not forward_installed and self.config.coalesce:
             # This request is served by someone else's dispatch — exactly
             # once per coalesced request: "gated" if it waited behind a
             # pending dispatcher, "attached" if the dispatch was already
             # live. A request that dispatched itself (created is not None,
-            # gated-then-promoted included) counts nothing.
+            # gated-then-promoted included) or installed the forward proxy
+            # (the ring owner's dispatch is "its own") counts nothing.
             self._m_coalesce.inc(1, "gated" if coalesced else "attached")
         # The dispatcher holds its OWN future: during its dispatch awaits it
         # is not yet counted as a waiter, so an impatient concurrent waiter
@@ -1089,13 +1756,77 @@ class DpowServer:
         # membership check above and this line, so the key lookup is safe.
         fut = created if created is not None else self.work_futures[block_hash]
         self._future_waiters[block_hash] = self._future_waiters.get(block_hash, 0) + 1
+        # A local waiter attaching to an ADOPTED takeover entry takes over
+        # its teardown (refcount below); the orphan reaper stands down.
+        self._adopted_orphan.discard(block_hash)
         # Deadline propagation: every waiter extends supervision to its own
         # budget (the latest deadline wins), so re-dispatch retries keep
         # healing for exactly as long as some waiter can still be answered
         # — and never longer.
         self.supervisor.track(block_hash, deadline)
+        if created is not None and block_hash in self._journaled:
+            pass  # the dispatcher journaled this deadline already
+        elif block_hash in self._journaled:
+            # A later waiter extended supervision past the journaled
+            # deadline: refresh the takeover record so an adopter heals
+            # for as long as some waiter can still be answered.
+            self._spawn(self._rejournal(block_hash))
         try:
-            if created is None and difficulty > self._dispatched_difficulty.get(
+            if (
+                created is None
+                and block_hash in self._forwarded
+                and difficulty > self._dispatched_difficulty.get(
+                    block_hash, self.config.base_difficulty
+                )
+            ):
+                # Raised-difficulty request joining a FORWARDED hash: the
+                # dispatch lives at the ring owner — send it a raised
+                # forward frame (the owner's own re-target path bumps the
+                # store difficulty and re-publishes) instead of mutating
+                # the dispatch from here. Serialized with concurrent
+                # raisers (_difficulty_lock), like the local re-target
+                # below: the rollback write after the forward await must
+                # not clobber a higher target another raiser installed
+                # while this one was suspended in the publish.
+                async with self._difficulty_lock(block_hash):
+                    current = self._dispatched_difficulty.get(
+                        block_hash, self.config.base_difficulty
+                    )
+                    if (
+                        difficulty > current
+                        and self.work_futures.get(block_hash) is fut
+                        and not fut.done()
+                    ):
+                        self._dispatched_difficulty[block_hash] = difficulty
+                        try:
+                            owner = self.replica.route(block_hash)
+                            if owner != self.replica.replica_id:
+                                await self._send_forward(
+                                    owner, block_hash, difficulty, deadline
+                                )
+                            else:
+                                # The ring owner is DEAD (route fell back
+                                # local): a forward frame would loop to our
+                                # own dispatch lane and raise nothing.
+                                # Re-target from HERE — the same store bump
+                                # + re-publish the supervisor republish
+                                # would do at grace expiry, but now and at
+                                # the raised target.
+                                await self.store.set(
+                                    f"block-difficulty:{block_hash}",
+                                    f"{difficulty:016x}",
+                                    expire=self.config.difficulty_expiry,
+                                )
+                                await self.fleet.publish_work(
+                                    block_hash, difficulty,
+                                    WorkType.ONDEMAND.value,
+                                    self._tracer.id_for(block_hash),
+                                )
+                                self.supervisor.dispatched(block_hash)
+                        except BaseException:
+                            self._dispatched_difficulty[block_hash] = current
+                            raise
+            elif created is None and difficulty > self._dispatched_difficulty.get(
                 block_hash, self.config.base_difficulty
             ):
                 # The in-flight dispatch was published at a weaker target

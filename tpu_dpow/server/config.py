@@ -113,6 +113,28 @@ class ServerConfig:
     # (quota still charged per request) instead of queueing for its own
     # admission slot. False restores the pre-coalescing admission path.
     coalesce: bool = True
+    # Cross-dispatch micro-batching: buffer one event-loop tick of v1 lane
+    # publishes (call_soon flush) so DIFFERENT hashes dispatched in the
+    # same tick share one WORK_BATCH frame. Off by default: the per-flush
+    # batching is always on; this adds one tick of publish latency to buy
+    # burst amortization (benchmarks/replicas.py measures it).
+    lane_flush: bool = False
+    # -- replication (tpu_dpow/replica/, docs/replication.md) ----------
+    # Expected ring size. > 1 makes this process one replica of a
+    # replicated orchestrator: it joins the replica registry in the
+    # SHARED store, owns a hash-partitioned slice of request space,
+    # forwards non-owned dispatches to their ring owner, and adopts a
+    # dead peer's journaled in-flight dispatches (leaderless takeover).
+    # Requires a shared store (sqlite/redis/degraded+) — construction
+    # refuses a per-process memory:// store.
+    replicas: int = 1
+    # Topic-safe ring member id (no '/', '+', '#'); empty derives one
+    # from the pid. Must be unique per replica process.
+    replica_id: str = ""
+    # Seconds without heartbeat-seq movement before a peer replica is
+    # declared dead and its in-flight dispatches adopted.
+    replica_ttl: float = 10.0
+    replica_heartbeat_interval: float = 2.0
     log_file: Optional[str] = None
 
 
@@ -194,6 +216,26 @@ def parse_args(argv=None) -> ServerConfig:
                    help="dispatch same-hash on-demand requests through "
                    "the admission queue independently instead of "
                    "attaching them to the pending dispatch")
+    p.add_argument("--lane_flush", action="store_true",
+                   help="buffer one event-loop tick of v1 lane publishes "
+                   "so different hashes dispatched in the same tick share "
+                   "one WORK_BATCH frame (cross-dispatch micro-batching)")
+    p.add_argument("--replicas", type=int, default=c.replicas,
+                   help="expected orchestrator ring size; > 1 joins the "
+                   "replica registry in the shared store, partitions "
+                   "request ownership, and adopts dead peers' in-flight "
+                   "dispatches (docs/replication.md; needs a shared "
+                   "store, not memory://)")
+    p.add_argument("--replica_id", default=c.replica_id,
+                   help="topic-safe ring member id, unique per replica "
+                   "(empty derives one from the pid)")
+    p.add_argument("--replica_ttl", type=float, default=c.replica_ttl,
+                   help="seconds without heartbeat movement before a peer "
+                   "replica is declared dead and adopted")
+    p.add_argument("--replica_heartbeat_interval", type=float,
+                   default=c.replica_heartbeat_interval,
+                   help="seconds between replica heartbeat/observe/"
+                   "takeover cadence ticks")
     p.add_argument("--statistics_interval", type=float, default=c.statistics_interval,
                    help="seconds between public statistics broadcasts "
                    "(reference: fixed 300)")
@@ -201,4 +243,8 @@ def parse_args(argv=None) -> ServerConfig:
                    default=c.base_difficulty)
     p.add_argument("--log_file", default=None)
     ns = p.parse_args(argv)
+    if ns.replicas > 1 and not ns.replica_id:
+        # Derive ONCE at the composition root so the MQTT client id and
+        # the ring member id agree (server/__main__.py).
+        ns.replica_id = f"r{os.getpid()}"
     return ServerConfig(**vars(ns))

@@ -103,10 +103,17 @@ class MemoryStore(Store):
 
     TTLs use an injectable clock so tests can drive expiry deterministically
     instead of sleeping.
+
+    ``shared=True`` marks ONE instance deliberately handed to several
+    embedded servers in the same process (replica tests, benchmarks): a
+    replicated DpowServer refuses a plain MemoryStore at construction —
+    per-process memory would split the quota ledger and replica registry —
+    but a shared instance IS a shared store (docs/replication.md).
     """
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, clock: Callable[[], float] = time.monotonic, shared: bool = False):
         self._clock = clock
+        self.shared = shared
         self._data: Dict[str, object] = {}
         self._expiry: Dict[str, float] = {}
         self._lock = asyncio.Lock()

@@ -129,4 +129,12 @@ class RedisStore(Store):
         return set(await self._c(self._redis.smembers(key)))
 
     async def keys(self, pattern: str = "*") -> list:
-        return await self._c(self._redis.keys(pattern))
+        # SCAN, never KEYS: the replica registry polls this every
+        # heartbeat tick against the shared production store, and KEYS is
+        # a single blocking O(total-keyspace) walk that stalls every other
+        # client for its duration. SCAN amortizes the same walk into
+        # bounded steps the server interleaves with real traffic.
+        out = []
+        async for key in self._redis.scan_iter(match=pattern, count=500):
+            out.append(key)
+        return out

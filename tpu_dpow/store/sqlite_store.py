@@ -67,6 +67,11 @@ class SqliteStore(Store):
         # WAL: readers never block the writer; fits the single-writer
         # asyncio process with ops CLIs peeking at the same file.
         self._db.execute("PRAGMA journal_mode=WAL")
+        # LIKE is ASCII-case-insensitive by default, but keys() uses it as
+        # a prefix filter that must match the case-SENSITIVE fnmatch
+        # fallback and the Memory/Redis stores — e.g. two replica ids
+        # differing only by case must not read each other's journal slice.
+        self._db.execute("PRAGMA case_sensitive_like=ON")
         self._db.commit()
 
     async def close(self) -> None:
@@ -82,6 +87,20 @@ class SqliteStore(Store):
         self._writes += 1
         if self._writes % _SWEEP_EVERY == 0:
             self.sweep()
+
+    def _begin_immediate(self) -> None:
+        """Take the WRITE lock before reading: the read-modify-write ops
+        (incrby, setnx, hincrby) are the atomic primitives the replica
+        ring's epoch allocator, adoption election, and quota ledger rest
+        on, and several server PROCESSES may share one sqlite file
+        (docs/replication.md). Within one process the single event loop
+        already serializes them; across processes two connections can both
+        read the same prior state under DEFERRED isolation and lose an
+        update — observed as two replicas allocating the SAME epoch.
+        BEGIN IMMEDIATE serializes at the database (WAL + the stdlib's
+        default 5 s busy timeout handles contention)."""
+        if not self._db.in_transaction:
+            self._db.execute("BEGIN IMMEDIATE")
 
     def sweep(self) -> int:
         """Purge expired kv rows; returns how many were removed."""
@@ -151,9 +170,32 @@ class SqliteStore(Store):
         self._commit()
 
     async def setnx(self, key: str, value: str, expire: Optional[float] = None) -> bool:
-        if self._get_row(key) is not None:
-            return False
-        await self.set(key, value, expire)
+        self._begin_immediate()
+        try:
+            self._expect_type(key, "kv")
+            # Liveness checked in SQL, NOT via _get_row: its lazy
+            # expired-row DELETE commits, which would end the IMMEDIATE
+            # transaction and let a concurrent process win the same
+            # election before our INSERT. The upsert below overwrites an
+            # expired row, so it needs no delete first.
+            row = self._db.execute(
+                "SELECT 1 FROM kv WHERE key = ? AND "
+                "(expires_at IS NULL OR expires_at > ?) LIMIT 1",
+                (key, time.time()),
+            ).fetchone()
+            if row is not None:
+                self._db.rollback()
+                return False
+            self._db.execute(
+                "INSERT INTO kv (key, value, expires_at) VALUES (?, ?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value = excluded.value, "
+                "expires_at = excluded.expires_at",
+                (key, value, self._deadline(expire)),
+            )
+        except BaseException:
+            self._db.rollback()
+            raise
+        self._commit()
         return True
 
     async def delete(self, *keys: str) -> int:
@@ -184,22 +226,27 @@ class SqliteStore(Store):
         return False
 
     async def incrby(self, key: str, amount: int = 1) -> int:
-        self._expect_type(key, "kv")
-        row = self._db.execute(
-            "SELECT value, expires_at FROM kv WHERE key = ?", (key,)
-        ).fetchone()
-        now = time.time()
-        if row is None or (row[1] is not None and row[1] <= now):
-            current, deadline = 0, None
-        else:
-            current, deadline = int(row[0]), row[1]  # TTL preserved (Redis INCRBY)
-        new = current + amount
-        self._db.execute(
-            "INSERT INTO kv (key, value, expires_at) VALUES (?, ?, ?) "
-            "ON CONFLICT(key) DO UPDATE SET value = excluded.value, "
-            "expires_at = excluded.expires_at",
-            (key, str(new), deadline),
-        )
+        self._begin_immediate()
+        try:
+            self._expect_type(key, "kv")
+            row = self._db.execute(
+                "SELECT value, expires_at FROM kv WHERE key = ?", (key,)
+            ).fetchone()
+            now = time.time()
+            if row is None or (row[1] is not None and row[1] <= now):
+                current, deadline = 0, None
+            else:
+                current, deadline = int(row[0]), row[1]  # TTL preserved (Redis INCRBY)
+            new = current + amount
+            self._db.execute(
+                "INSERT INTO kv (key, value, expires_at) VALUES (?, ?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value = excluded.value, "
+                "expires_at = excluded.expires_at",
+                (key, str(new), deadline),
+            )
+        except BaseException:
+            self._db.rollback()
+            raise
         self._commit()
         return new
 
@@ -231,9 +278,14 @@ class SqliteStore(Store):
         )
 
     async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
-        current = await self.hget(key, field)
-        new = int(current or 0) + amount
-        await self.hset(key, {field: str(new)})
+        self._begin_immediate()
+        try:
+            current = await self.hget(key, field)
+            new = int(current or 0) + amount
+            await self.hset(key, {field: str(new)})
+        except BaseException:
+            self._db.rollback()
+            raise
         return new
 
     # -- sets ------------------------------------------------------------
@@ -267,6 +319,37 @@ class SqliteStore(Store):
 
     async def keys(self, pattern: str = "*") -> list:
         now = time.time()
+        # Pure-prefix patterns ("replica:member:*") are filtered in SQL:
+        # the replica registry polls read_members every heartbeat tick per
+        # replica against the shared production store, and a Python-side
+        # fnmatch over every key is O(total store keys) per tick — the
+        # store is expected to hold millions of block:*/account:* rows.
+        prefix = pattern[:-1] if pattern.endswith("*") else None
+        if prefix is not None and not any(c in prefix for c in "*?["):
+            like = (
+                prefix.replace("\\", "\\\\")
+                .replace("%", "\\%")
+                .replace("_", "\\_")
+                + "%"
+            )
+            out = {
+                row[0]
+                for row in self._db.execute(
+                    "SELECT key FROM kv WHERE key LIKE ? ESCAPE '\\' "
+                    "AND (expires_at IS NULL OR expires_at > ?)",
+                    (like, now),
+                ).fetchall()
+            }
+            for table in ("hashes", "sets_"):
+                out.update(
+                    r[0]
+                    for r in self._db.execute(
+                        f"SELECT DISTINCT key FROM {table} "
+                        "WHERE key LIKE ? ESCAPE '\\'",
+                        (like,),
+                    )
+                )
+            return list(out)
         out = {
             row[0]
             for row in self._db.execute(

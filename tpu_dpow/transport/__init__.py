@@ -186,10 +186,16 @@ def default_users(server_password: str = "dpowserver", client_password: str = "c
     return {
         "dpowserver": User(
             password=server_password,
-            acl_pub=("work/#", "cancel/#", "heartbeat", "statistics", "client/#"),
+            # result/#: addressed result relays between orchestrator
+            # replicas (result/{replica}/{type}); replica/#: the
+            # forwarded-dispatch lanes replica/dispatch/{id}. Both are
+            # server↔server traffic — every replica connects as
+            # dpowserver (tpu_dpow.replica, docs/replication.md).
+            acl_pub=("work/#", "cancel/#", "heartbeat", "statistics",
+                     "client/#", "result/#", "replica/#"),
             # fleet/#: worker capability announces (tpu_dpow.fleet) — an
             # additive grant over the reference matrix.
-            acl_sub=("result/#", "fleet/#"),
+            acl_sub=("result/#", "fleet/#", "replica/#"),
         ),
         "client": User(
             password=client_password,
