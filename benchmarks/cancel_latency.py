@@ -1,4 +1,6 @@
-"""Cancel drain latency: cancel-received → device lanes actually free.
+"""Cancel latency: client-visible cancel, device drain, and the A/B that
+ISSUE 10 is about — chunked relaunch boundaries vs persistent mid-launch
+control.
 
 A cancel resolves the requester's future immediately (client-visible cancel
 is ~0 ms), but the device is still grinding the cancelled job's in-flight
@@ -7,16 +9,24 @@ that residue. Cancel is the reference's latency-critical control edge
 (SURVEY.md §3.5: a worker grinding a stale hash is a worker lost to the
 swarm); here the analog is lanes parked on a cancelled hash.
 
-Measured as the OPERATIONAL definition: time from cancel() of a hard
-in-flight job to a fresh easy request's work arriving, vs the same easy
-request's solo latency on an idle engine. added_p50_ms is the drain tax.
+Chunked mode bounds the residue by construction: only the head-of-queue
+launch may run full run_steps width; pipelined successors are capped at
+shared_steps_cap windows (backend/jax_backend.py _dispatch_next), so
+worst-case residue is run_steps + (pipeline-1)*shared_steps_cap windows.
+Persistent mode (run_mode=persistent) removes the coupling instead: the
+launch spans persistent_steps windows (>= 10x the chunked cap) and a
+cancel lands MID-LAUNCH through the control channel within one
+control_poll_steps interval (docs/device_sharding.md).
 
-The engine bounds it by construction: only the head-of-queue launch may run
-full run_steps width; pipelined successors are capped at shared_steps_cap
-windows (backend/jax_backend.py _dispatch_next), so worst-case residue is
-run_steps + (pipeline-1)*shared_steps_cap windows of scan.
+Three measurements per mode:
+  * solo_p50_ms        — easy request on an idle engine (baseline);
+  * post_cancel_p50_ms — cancel a hard in-flight job, then time a fresh
+                         easy request (the operational drain tax);
+  * cancel_to_stop_p50_ms — cancel() to the device lanes actually free
+                         (every launch carrying the hard job returned).
 
 Usage: python benchmarks/cancel_latency.py [--n 10] [--settle 0.25]
+           [--run_mode chunked|persistent | --ab] [--out FILE]
 """
 
 from __future__ import annotations
@@ -38,14 +48,29 @@ RNG = np.random.default_rng(0xCA)
 UNREACHABLE = (1 << 64) - 2  # keeps every lane busy until the cancel
 
 
-async def run(n: int, settle: float) -> None:
+async def _drain_job(backend, block_hash: str, timeout: float = 60.0) -> float:
+    """Seconds until no in-flight launch carries the job (lanes free)."""
+    t0 = time.perf_counter()
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while any(
+        any(j.block_hash == block_hash for j in rec.jobs)
+        for rec in getattr(backend, "_inflight", ())
+    ):
+        if loop.time() > deadline:
+            raise TimeoutError("cancelled job never drained off the device")
+        await asyncio.sleep(0.002)
+    return time.perf_counter() - t0
+
+
+async def run(n: int, settle: float, run_mode: str) -> dict:
     import jax
 
     platform = jax.devices()[0].platform
     easy = nc.derive_work_difficulty(1.0)
     if platform != "tpu":
         easy = min(easy, 0xFFF0000000000000)  # keep CPU runs sane
-    backend = get_backend("jax")
+    backend = get_backend("jax", run_mode=run_mode)
     # Solve records carry applied-launch counts: the post-cancel probe's
     # histogram shows whether it solved on its first readback (the corpse-
     # aware full-width head) or chained extra wire round trips behind the
@@ -56,7 +81,7 @@ async def run(n: int, settle: float) -> None:
 
     from collections import Counter
 
-    solo, post_cancel = [], []
+    solo, post_cancel, cancel_stop = [], [], []
     solo_launches: Counter = Counter()
     probe_launches: Counter = Counter()
 
@@ -77,46 +102,97 @@ async def run(n: int, settle: float) -> None:
         await asyncio.sleep(settle)  # pipeline fills with the hard job's scans
         t0 = time.perf_counter()
         await backend.cancel(hard)
+        stop_task = asyncio.ensure_future(_drain_job(backend, hard))
         h2 = RNG.bytes(32).hex().upper()
         await backend.generate(WorkRequest(h2, easy))
         post_cancel.append(time.perf_counter() - t0)
+        cancel_stop.append(await stop_task)
         try:
             await t_hard
         except WorkCancelled:
             pass
         _bootstrap.drain_solves(backend, probe_launches)
 
+    # The persistent control channel's own telemetry, if the mode used it.
+    from tpu_dpow import obs
+
+    snap = obs.snapshot()
+    control = snap.get("dpow_backend_persistent_control_total", {}).get(
+        "series", {}
+    )
     await backend.close()
     solo_ms = np.asarray(sorted(solo)) * 1e3
     drain_ms = np.asarray(sorted(post_cancel)) * 1e3
-    print(
-        json.dumps(
-            {
-                "bench": "cancel_drain_latency",
-                "platform": platform,
-                "n": n,
-                "solo_p50_ms": round(float(np.percentile(solo_ms, 50)), 2),
-                "post_cancel_p50_ms": round(float(np.percentile(drain_ms, 50)), 2),
-                "post_cancel_p95_ms": round(float(np.percentile(drain_ms, 95)), 2),
-                "added_p50_ms": round(
-                    float(np.percentile(drain_ms, 50) - np.percentile(solo_ms, 50)), 2
-                ),
-                "bound_windows": backend.run_steps
-                + (backend.pipeline - 1) * backend.shared_steps_cap,
-                "solo_launches_per_solve": dict(sorted(solo_launches.items())),
-                "probe_launches_per_solve": dict(sorted(probe_launches.items())),
-                # Measured with record_timeline on (per-launch stamps on the
-                # timed path; trace_cost.py prices it) — cross-capture
-                # comparisons should match regimes (ADVICE r4).
-                "timeline_instrumented": True,
-                "geometry": {
-                    "run_steps": backend.run_steps,
-                    "pipeline": backend.pipeline,
-                    "shared_steps_cap": backend.shared_steps_cap,
-                },
-            }
-        )
-    )
+    stop_ms = np.asarray(sorted(cancel_stop)) * 1e3
+    # One poll interval of scan = the persistent mode's cancel bound; the
+    # chunked bound is the launch-residue window count.
+    poll_window_ms = None
+    if run_mode == "persistent" and solo:
+        # per-window scan time ~ solo chunk rate is noisy; report the
+        # configured interval in windows instead (the contract's unit).
+        poll_window_ms = backend.control_poll_steps
+    return {
+        "bench": "cancel_drain_latency",
+        "run_mode": run_mode,
+        "platform": platform,
+        "n": n,
+        "solo_p50_ms": round(float(np.percentile(solo_ms, 50)), 2),
+        "post_cancel_p50_ms": round(float(np.percentile(drain_ms, 50)), 2),
+        "post_cancel_p95_ms": round(float(np.percentile(drain_ms, 95)), 2),
+        "added_p50_ms": round(
+            float(np.percentile(drain_ms, 50) - np.percentile(solo_ms, 50)), 2
+        ),
+        "cancel_to_stop_p50_ms": round(float(np.percentile(stop_ms, 50)), 2),
+        "cancel_to_stop_p95_ms": round(float(np.percentile(stop_ms, 95)), 2),
+        "bound_windows": backend.run_steps
+        + (backend.pipeline - 1) * backend.shared_steps_cap
+        if run_mode == "chunked"
+        else backend.control_poll_steps,
+        "launch_windows_cap": backend.run_steps
+        if run_mode == "chunked"
+        else backend.persistent_steps,
+        "control_poll_steps": poll_window_ms,
+        "persistent_control_delivered": control or None,
+        "solo_launches_per_solve": dict(sorted(solo_launches.items())),
+        "probe_launches_per_solve": dict(sorted(probe_launches.items())),
+        # Measured with record_timeline on (per-launch stamps on the
+        # timed path; trace_cost.py prices it) — cross-capture
+        # comparisons should match regimes (ADVICE r4).
+        "timeline_instrumented": True,
+        "geometry": {
+            "run_steps": backend.run_steps,
+            "pipeline": backend.pipeline,
+            "shared_steps_cap": backend.shared_steps_cap,
+            "persistent_steps": backend.persistent_steps,
+        },
+    }
+
+
+async def main(args) -> None:
+    modes = ["chunked", "persistent"] if args.ab else [args.run_mode]
+    results = [await run(args.n, args.settle, m) for m in modes]
+    out = results[0] if len(results) == 1 else {
+        "bench": "cancel_drain_latency_ab",
+        "ab": results,
+        # The A/B headline: persistent must hold cancel-to-stop at or
+        # under one poll interval of scan while running launches >= 10x
+        # the chunked window cap (ISSUE 10 acceptance).
+        "launch_cap_ratio": round(
+            results[1]["launch_windows_cap"]
+            / max(1, results[0]["launch_windows_cap"]),
+            1,
+        ),
+        "cancel_to_stop_ratio": round(
+            results[1]["cancel_to_stop_p50_ms"]
+            / max(0.01, results[0]["cancel_to_stop_p50_ms"]),
+            2,
+        ),
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":
@@ -124,5 +200,11 @@ if __name__ == "__main__":
     p.add_argument("--n", type=int, default=10)
     p.add_argument("--settle", type=float, default=0.25,
                    help="seconds to let the hard job fill the pipeline")
+    p.add_argument("--run_mode", default="chunked",
+                   choices=["chunked", "persistent"],
+                   help="engine launch structure under test")
+    p.add_argument("--ab", action="store_true",
+                   help="run both modes and print the A/B record")
+    p.add_argument("--out", default=None, help="also write the record here")
     args = p.parse_args()
-    asyncio.run(run(args.n, args.settle))
+    asyncio.run(main(args))

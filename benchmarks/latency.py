@@ -36,6 +36,7 @@ async def run(
     backend_name: str,
     step_ladder: str = "x4",
     mesh_devices: int = 0,
+    run_mode: str = "chunked",
 ) -> None:
     import jax
 
@@ -43,6 +44,11 @@ async def run(
     if backend_name == "jax" and not on_tpu:
         difficulty = min(difficulty, 0xFFF0000000000000)  # keep CPU runs sane
     kwargs = {"step_ladder": step_ladder} if backend_name == "jax" else {}
+    if backend_name == "jax":
+        # ISSUE 10 A/B: the persistent path must hold e2e p50 at default
+        # difficulty no worse than chunked while cutting the per-request
+        # host round trips to O(1) (launches_per_solve below shows them).
+        kwargs["run_mode"] = run_mode
     if backend_name == "jax" and mesh_devices > 0:
         # Full-backend A/B vs the plain path: mesh_devices=1 runs the exact
         # ganged engine (shard_map launches, pmin election, replicated
@@ -83,6 +89,7 @@ async def run(
             {
                 "bench": "single_request_latency",
                 "backend": backend_name,
+                "run_mode": run_mode if backend_name == "jax" else None,
                 "mesh_devices": mesh_devices,
                 "platform": jax.devices()[0].platform,
                 "difficulty": f"{difficulty:016x}",
@@ -115,9 +122,14 @@ if __name__ == "__main__":
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="run the ganged engine at this gang size (0 = plain "
                    "path; 1 = gang machinery A/B on one device)")
+    p.add_argument("--run_mode", default="chunked",
+                   choices=["chunked", "persistent"],
+                   help="launch structure A/B (backend=jax): persistent = "
+                   "span-sized launches with mid-launch control")
     args = p.parse_args()
     if args.difficulty:
         diff = int(args.difficulty, 16)
     else:
         diff = nc.derive_work_difficulty(args.multiplier)
-    asyncio.run(run(args.n, diff, args.backend, args.step_ladder, args.mesh_devices))
+    asyncio.run(run(args.n, diff, args.backend, args.step_ladder,
+                    args.mesh_devices, args.run_mode))

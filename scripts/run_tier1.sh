@@ -46,6 +46,14 @@ if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
 else
     echo "REPLICA=fail"
 fi
+# Persistent-path coverage at a glance (ISSUE 10): how many tier-1 tests
+# pin the mid-launch control contract (runloop control channel + engine
+# run_mode=persistent + the warm-ladder pins riding in test_backend.py).
+# Collection only — does not rerun anything.
+PERSISTENT=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_persistent.py tests/test_backend.py -k persistent \
+    --collect-only -q -p no:cacheprovider 2>/dev/null | grep -c '::' || true)
+echo "PERSISTENT=${PERSISTENT}"
 # dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
 # or how many findings escaped the baseline (docs/analysis.md).
 DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)

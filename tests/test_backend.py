@@ -426,6 +426,68 @@ def test_warm_shapes_off_is_transparent():
     assert b._pick_shape(30, 16) == (16, 16)
 
 
+def test_persistent_launch_shape_is_in_the_warm_ladder():
+    """Regression pin (ISSUE 10 satellite; the PR-4 cold-XLA-compile
+    lesson): persistent mode's span-sized launch shape must sit in the
+    warm ladder — both the singleton and the batched rung — so no
+    unwarmed shape is ever on the dispatch path. The steerable mega-shape
+    is the ONLY run rung besides the probe singleton: quantization is
+    pointless when the while_loop early-exits per row."""
+    b = make_backend(
+        run_mode="persistent", persistent_steps=16, warm_shapes=True,
+        max_batch=16,
+    )
+    assert b._step_counts() == [1, 16]
+    # the rung every difficulty maps to IS the persistent shape
+    assert b._steps_for(EASY) == 16
+    assert b._steps_for((1 << 64) - 2) == 16
+    # warm both rungs -> dispatch picks the mega-shape, never a cold one
+    b._warm = {(1, 1), (1, 16), (16, 1), (16, 16)}
+    assert b._pick_shape(1, b._steps_for(EASY)) == (1, 16)
+    assert b._pick_shape(9, b._steps_for(EASY)) == (16, 16)
+    # cold mega-rung -> falls back to a warmed shape, not an inline compile
+    b._warm = {(1, 1), (16, 1)}
+    assert b._pick_shape(1, 16) == (1, 1)
+
+
+def test_persistent_warm_engine_never_compiles_on_the_dispatch_path():
+    """The dispatch-path warm guard, persistent flavor: a cold persistent
+    engine under a burst must only launch shapes already warmed (the
+    controlled while_loop compiles are MORE expensive than the chunked
+    grid's, so an inline compile would park the whole batch behind it)."""
+
+    async def run():
+        b = make_backend(
+            run_mode="persistent", warm_shapes=True, max_batch=16
+        )
+        await b.setup()
+        real_dispatch = b._dispatch_next
+        cold_dispatches = []
+
+        def recording_dispatch(*args, **kw):
+            rec = real_dispatch(*args, **kw)
+            if rec is not None and rec.shape not in b._warm:
+                cold_dispatches.append(rec.shape)
+            return rec
+
+        b._dispatch_next = recording_dispatch
+        reqs = [WorkRequest(random_hash(), EASY) for _ in range(13)]
+        works = await asyncio.gather(*(b.generate(r) for r in reqs))
+        for r, w in zip(reqs, works):
+            nc.validate_work(r.block_hash, w, EASY)
+        assert not cold_dispatches, (
+            f"dispatch path launched unwarmed persistent shapes "
+            f"{cold_dispatches}"
+        )
+        if b._warm_task is not None:
+            await b._warm_task  # CPU compiles are cheap: let it finish
+        assert (1, b.persistent_steps) in b._warm
+        assert (16, b.persistent_steps) in b._warm
+        await b.close()
+
+    asyncio.run(asyncio.wait_for(run(), 120))
+
+
 def test_warm_engine_never_compiles_on_the_dispatch_path():
     """Regression guard for the e2e soak flake: a COLD warm_shapes engine
     hit by a burst must serve every request from shapes already in the

@@ -60,7 +60,8 @@ import numpy as np
 
 from .. import obs
 from ..models import WorkRequest
-from ..ops import pallas_kernel, search
+from ..ops import control as ctl
+from ..ops import pallas_kernel, runloop, search
 from ..resilience.clock import Clock, SystemClock
 from ..utils import nanocrypto as nc
 from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
@@ -161,6 +162,11 @@ class _Launch:
     # Readback-await task, created when this launch reaches the head of the
     # FIFO; persists across wakeup-interrupted waits (engine loop).
     waiter: "Optional[asyncio.Task]" = None
+    # Persistent mode (run_mode=persistent): the launch's live control
+    # block + its slot id in ops/control.py's table. None on chunked
+    # launches — they cannot be steered mid-flight.
+    control: "Optional[ctl.LaunchControl]" = None
+    slot: int = 0
 
 
 class JaxWorkBackend(WorkBackend):
@@ -200,6 +206,9 @@ class JaxWorkBackend(WorkBackend):
         devices: int = 0,  # >=1: fan this many local devices per hash (pmap)
         device_shard: str = "split",  # fan partition policy: 'split' | 'interleave'
         run_steps: Optional[int] = None,  # cap on windows per device launch
+        run_mode: str = "chunked",  # 'chunked' | 'persistent' (mid-launch control)
+        control_poll_steps: int = 0,  # persistent: windows between control polls (0 = auto)
+        persistent_steps: Optional[int] = None,  # persistent: windows per launch (None = auto)
         warm_shapes: Optional[bool] = None,  # background-compile launch shapes
         launch_timeout: Optional[float] = None,  # s; None = auto (300 on TPU)
         pipeline: int = 2,  # launches in flight at once (1 = no overlap)
@@ -308,6 +317,51 @@ class JaxWorkBackend(WorkBackend):
             )
         max_by_window = ((1 << 31) - 1) // self.chunk
         self.run_steps = max(1, min(run_steps, max_by_window))
+        # Persistent run mode: launches are a device-resident while_loop
+        # (ops/runloop.py) polling a host control channel every
+        # control_poll_steps windows, so cancel/raise/cover_range land
+        # MID-LAUNCH and the launch length no longer caps cancel latency.
+        # That lifts the windows-per-launch cap from run_steps (the chunked
+        # cancel-latency bound) to persistent_steps — span-sized: one host
+        # round trip per REQUEST instead of per run_steps windows. The
+        # 2^31 ceiling applies per WINDOW (the device advances the 64-bit
+        # base between windows), not per launch, so the span is unbounded.
+        if run_mode not in ("chunked", "persistent"):
+            raise WorkError(
+                f"run_mode must be 'chunked' or 'persistent', not {run_mode!r}"
+            )
+        if run_mode == "persistent" and self.mesh is not None:
+            # The mesh gang is one SPMD program with collectives; each
+            # device would invoke the control poll independently while the
+            # host mutates the block, so two devices can observe a command
+            # at different poll blocks, diverge in while_loop trip count,
+            # and deadlock the next collective. Until the poll is pinned
+            # to one device and broadcast (io_callback sharding=, jax >=
+            # 0.6 where the mesh runs at all), persistent mode pairs with
+            # the fan — whose per-device loops share no collective.
+            raise WorkError(
+                "run_mode=persistent cannot drive the shard_map mesh: the "
+                "replicated control poll can diverge across devices inside "
+                "one SPMD program (collective deadlock); use devices=N "
+                "(the pmap fan) for persistent multi-chip search"
+            )
+        self.run_mode = run_mode
+        if control_poll_steps < 0:
+            raise WorkError("control_poll_steps must be >= 0 (0 = auto)")
+        # Poll cadence tradeoff: each poll is an io_callback (a host touch —
+        # ~free locally, a round trip through a remote-chip tunnel) and one
+        # poll interval is the worst-case cancel/raise/rebase latency. The
+        # TPU default (8 windows ≈ 240 ms of scan at the default geometry)
+        # amortizes tunnel polls; the CPU default polls every window (test
+        # windows are tiny and local callbacks are cheap).
+        self.control_poll_steps = control_poll_steps or (8 if on_tpu else 1)
+        if persistent_steps is None:
+            # >= 10x the chunked window cap (the A/B floor the benchmarks
+            # hold persistent mode to), default 16x: at the TPU default
+            # geometry that is one ~8 s launch per request at 16x the
+            # chunked span, cancel still bounded by one poll interval.
+            persistent_steps = self.run_steps * 16
+        self.persistent_steps = max(persistent_steps, 1)
         self.max_batch = max_batch
         self.interpret = interpret
         # Every distinct (batch, steps) launch shape is a separate XLA
@@ -369,6 +423,10 @@ class JaxWorkBackend(WorkBackend):
         # asyncio's shared to_thread pool until the pool starves.
         self._executor = None
         self._jobs: Dict[str, _Job] = {}
+        # In-flight launch records, oldest first. Owned by the engine loop;
+        # kept on the instance so the persistent control writers (cancel /
+        # raise_difficulty / cover_range) can reach a RUNNING launch.
+        self._inflight: deque = deque()
         self._last_rung = -1  # round-robin cursor over difficulty rungs
         self._engine_task: Optional[asyncio.Task] = None
         self._wakeup = asyncio.Event()
@@ -442,6 +500,24 @@ class JaxWorkBackend(WorkBackend):
             "dpow_backend_device_ema_hs",
             "EMA of win-attributed scan rate on the device's own scan "
             "clock (H/s)", ("device",))
+        # Persistent-mode families (run_mode=persistent): launch length,
+        # control-channel traffic and poll-to-effect latency — the numbers
+        # that prove mid-launch control works (docs/observability.md).
+        self._m_p_windows = reg.histogram(
+            "dpow_backend_persistent_launch_windows",
+            "Windows a persistent launch actually ran before win/cancel/"
+            "span end",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096))
+        self._m_p_polls = reg.counter(
+            "dpow_backend_persistent_polls_total",
+            "Mid-launch control polls served to devices (io_callback reads)")
+        self._m_p_control = reg.counter(
+            "dpow_backend_persistent_control_total",
+            "Mid-launch control commands delivered on device", ("action",))
+        self._m_p_effect = reg.histogram(
+            "dpow_backend_persistent_effect_seconds",
+            "Control command issue -> device delivery latency on the "
+            "engine's injectable clock")
         # Fan bookkeeping: per-device busy seconds + EMA folds, the wall
         # anchor for busy-fraction, and the last win's attribution record
         # (device index, hashes, scan-clock elapsed) — the engine-level
@@ -469,9 +545,12 @@ class JaxWorkBackend(WorkBackend):
                 f"(nonce {int(hi.flat[0]):08x}{int(lo.flat[0]):08x})"
             )
         self._warm.add((1, 1))
-        if self.run_steps > 1 and not self.warm_shapes:
+        if not self.warm_shapes and len(self._step_counts()) > 1:
             # Warming off (CPU: compiles are cheap): pay the run-mode
             # ladder compiles inline so behavior is fully deterministic.
+            # (_step_counts, not run_steps: persistent mode's mega-shape
+            # rung exists even at run_steps=1 and the first request must
+            # not eat its compile.)
             for steps in self._step_counts()[1:]:
                 await self._timed_launch(np.stack([probe]), steps)
                 self._warm.add((1, steps))
@@ -498,7 +577,7 @@ class JaxWorkBackend(WorkBackend):
             # difficulty raises the shared job's target: the eventual nonce
             # then satisfies every waiter; a weaker/equal one just shares.
             if request.difficulty > existing.difficulty:
-                existing.set_difficulty(request.difficulty)
+                self._raise_job_target(existing, request.difficulty)
             return await self._await_job(existing)
         job = _Job(
             block_hash=key,
@@ -531,6 +610,8 @@ class JaxWorkBackend(WorkBackend):
     async def _await_job(self, job: _Job) -> str:
         def abort():  # engine drops cancelled jobs from the next pack
             job.cancelled = True
+            # ...and a persistent launch frees the rows within one poll.
+            self._control_cancel_job(job)
 
         return await await_shared_job(job, abort)
 
@@ -539,17 +620,95 @@ class JaxWorkBackend(WorkBackend):
         if job is not None and not job.future.done():
             job.cancelled = True
             job.future.set_exception(WorkCancelled(job.block_hash))
+            # Persistent launches are steerable: the device frees the
+            # cancelled rows within one poll interval instead of grinding
+            # them to span end (the whole point of run_mode=persistent).
+            self._control_cancel_job(job)
 
     async def raise_difficulty(self, block_hash: str, difficulty: int) -> bool:
         """Retarget a running job in place; the engine loop's per-launch
         difficulty snapshot keeps an in-flight chunk's weaker hit searching
-        on past it at the new target."""
+        on past it at the new target. Persistent launches are retargeted
+        MID-FLIGHT through the control channel — the running while_loop
+        swaps its difficulty words at the next poll."""
         job = self._jobs.get(nc.validate_block_hash(block_hash))
         if job is None or job.cancelled or job.future.done():
             return False
         if difficulty > job.difficulty:
-            job.set_difficulty(difficulty)
+            self._raise_job_target(job, difficulty)
         return True
+
+    def _raise_job_target(self, job: _Job, difficulty: int) -> None:
+        """Raise a job's target AND steer any running persistent launch
+        (shared by raise_difficulty and the dedup-upgrade path)."""
+        prev_miss = job.inflight_miss
+        job.set_difficulty(difficulty)
+        covered, span = self._control_raise_job(job, difficulty)
+        if covered:
+            # A live launch carries the raised target in place: the job
+            # stays covered (set_difficulty reset it to uncovered for
+            # the chunked case, where in-flight spans scan the OLD
+            # target). The covering launch's divide-back clamps at 1.0.
+            job.inflight_miss = min(
+                prev_miss, self._miss_factor(difficulty, span)
+            )
+
+    # -- persistent mid-launch control ------------------------------------
+
+    def _live_controls(self, job: _Job) -> list:
+        """(rec, row) for each in-flight persistent launch carrying ``job``,
+        oldest first."""
+        out = []
+        for rec in self._inflight:
+            if rec.control is None:
+                continue
+            for i, j in enumerate(rec.jobs):
+                if j is job:
+                    out.append((rec, i))
+        return out
+
+    def _control_cancel_job(self, job: _Job) -> None:
+        """Free the job's device rows: deliver CANCEL to every in-flight
+        persistent launch still scanning it. Cancel needs no epoch check —
+        stopping a row is valid whatever partition it was aimed at."""
+        for rec, row in self._live_controls(job):
+            rec.control.cancel(row)
+
+    def _control_raise_job(self, job: _Job, difficulty: int) -> tuple:
+        """Deliver a raised target to running launches; (covered, span)
+        where covered means at least one CURRENT-epoch launch now scans
+        the job at the new difficulty. Stale-epoch launches are skipped —
+        their control word is dead (the PR-6 fence: a launch aimed at a
+        region the job has left must not be steered as if it were live)."""
+        covered, span = False, 0
+        for rec, row in self._live_controls(job):
+            if rec.dev_epochs[row] != job.dev_epoch:
+                continue
+            if rec.control.raise_difficulty(row, difficulty, epoch=job.dev_epoch):
+                covered, span = True, max(span, rec.span)
+        return covered, span
+
+    def _control_rebase_job(self, job: _Job) -> tuple:
+        """Re-aim the NEWEST in-flight persistent launch at the job's new
+        partition (cover_range already rewrote the job-side frontier and
+        bumped ``dev_epoch``); the job's rows in OLDER launches are stale
+        under the new epoch, so they are KILLED — the row stops at its
+        next poll AND the control word goes dead, refusing any later
+        write (the PR-6 fence for running launches).
+        Returns (covered, span) of the rebased launch."""
+        covered, span = False, 0
+        for rec, row in reversed(self._live_controls(job)):
+            if not covered:
+                span_dev = self.chunk_per_shard * rec.shape[1]
+                if self.fan is not None:
+                    bases = self._fan_launch_bases(job, span_dev)
+                else:
+                    bases = [job.base]
+                if rec.control.rebase(row, bases, epoch=job.dev_epoch):
+                    covered, span = True, rec.span
+                    continue
+            rec.control.kill(row)
+        return covered, span
 
     async def cover_range(self, block_hash: str, nonce_range: tuple) -> bool:
         """Fleet re-cover: jump a running job's scan to an orphaned shard.
@@ -575,6 +734,14 @@ class JaxWorkBackend(WorkBackend):
             # the range this re-cover just claimed.
             job.dev_epoch += 1
         job.inflight_miss = 1.0
+        covered, span = self._control_rebase_job(job)
+        if covered:
+            # A running persistent launch was re-aimed at the new range
+            # mid-flight (no relaunch): treat it as the covering launch.
+            # Its divide-back at apply restores miss to ~1.0, so any tail
+            # of the range it did not reach re-dispatches from the new
+            # frontier — bounded overlap, never a gap.
+            job.inflight_miss = self._miss_factor(job.difficulty, span)
         self._wakeup.set()
         return True
 
@@ -593,6 +760,13 @@ class JaxWorkBackend(WorkBackend):
             if not job.future.done():
                 job.future.set_exception(WorkCancelled("backend closed"))
         self._jobs.clear()
+        # Persistent launches would otherwise grind their span out in the
+        # executor after close: cancel every row so the device threads
+        # return within one poll interval.
+        for rec in self._inflight:
+            if rec.control is not None:
+                for i in range(len(rec.jobs)):
+                    rec.control.cancel(i)
         self._wakeup.set()
         engine_task, self._engine_task = self._engine_task, None
         if engine_task is not None:
@@ -709,6 +883,14 @@ class JaxWorkBackend(WorkBackend):
         drain past the hit) at the cost of ~2x the warm compiles; which
         wins is an on-chip measurement (benchmarks/latency.py A/B).
         """
+        if self.run_mode == "persistent":
+            # One steerable mega-shape (plus the singleton the setup probe
+            # and cold fallbacks use): the while_loop's early exit makes
+            # run-length quantization pointless — every launch compiles to
+            # the same max_steps and returns on win/cancel/span end.
+            if self.persistent_steps > 1:
+                return [1, self.persistent_steps]
+            return [1]
         factor = 2 if self.step_ladder == "x2" else 4
         counts, steps = [1], 1
         while steps < self.run_steps:
@@ -726,7 +908,14 @@ class JaxWorkBackend(WorkBackend):
     def _steps_for(self, difficulty: int) -> int:
         """Windows one launch should cover for this difficulty: enough that
         the median solve finishes in a single round trip (2x the median
-        window count), clamped to the run_steps cancel-latency cap."""
+        window count), clamped to the run_steps cancel-latency cap.
+
+        Persistent mode has no cancel-latency cap to clamp to (the control
+        channel bounds cancel at one poll interval), so every difficulty
+        gets the span-sized launch — one host round trip per request, the
+        in-loop early exit returns easy rows after their first window."""
+        if self.run_mode == "persistent":
+            return self.persistent_steps
         median = math.log(2) / self._solve_p(difficulty)
         windows = 2 * median / self.chunk
         for steps in self._step_counts():
@@ -735,9 +924,15 @@ class JaxWorkBackend(WorkBackend):
         return self.run_steps
 
     def _submit_launch(
-        self, params_batch: np.ndarray, steps: int, timing: Optional[dict] = None
+        self,
+        params_batch: np.ndarray,
+        steps: int,
+        timing: Optional[dict] = None,
+        slot: int = 0,
     ) -> asyncio.Future:
-        """Hand a launch to the executor; device work starts immediately."""
+        """Hand a launch to the executor; device work starts immediately.
+        ``slot`` routes a persistent launch's control polls (0 = no control
+        block registered: the launch reads dead zeros and just runs)."""
         if self._executor is None:
             import concurrent.futures
 
@@ -746,8 +941,16 @@ class JaxWorkBackend(WorkBackend):
                 max_workers=self.pipeline + 1
             )
         loop = asyncio.get_running_loop()
+
+        def call_launch():
+            # Chunked launches (slot 0) keep the two-arg call: _launch
+            # wrappers installed by tests and tooling predate the slot.
+            if slot:
+                return self._launch(params_batch, steps, slot)
+            return self._launch(params_batch, steps)
+
         if timing is None:
-            return loop.run_in_executor(self._executor, self._launch, params_batch, steps)
+            return loop.run_in_executor(self._executor, call_launch)
 
         def timed():  # stamps the executor-queue and device stages
             timing["t_thread"] = time.perf_counter()
@@ -756,7 +959,7 @@ class JaxWorkBackend(WorkBackend):
             # clock (SystemClock: identical to the perf stamps; FakeClock:
             # deterministic, advanced only by the test).
             timing["t_thread_clock"] = self._clock.time()
-            out = self._launch(params_batch, steps)
+            out = call_launch()
             timing["t_done"] = time.perf_counter()
             timing["t_done_clock"] = self._clock.time()
             return out
@@ -787,7 +990,7 @@ class JaxWorkBackend(WorkBackend):
             f"batch={params_batch.shape[0]}, steps={steps}",
         )
 
-    def _launch(self, params_batch: np.ndarray, steps: int) -> tuple:
+    def _launch(self, params_batch: np.ndarray, steps: int, slot: int = 0) -> tuple:
         """One blocking batched device launch (called via to_thread).
 
         Returns (lo, hi) uint32[B] — absolute winning nonces per row,
@@ -797,8 +1000,12 @@ class JaxWorkBackend(WorkBackend):
         launch to ``steps`` consecutive windows in the same single dispatch
         (bigger ``nblocks`` grid / chunk), so the whole span costs one
         host↔device round trip and early-exits per request as soon as a
-        window hits.
+        window hits. In persistent mode the same span runs as a
+        device-resident while_loop polling control slot ``slot`` between
+        windows (one compile per shape; the slot id is a traced value).
         """
+        if self.run_mode == "persistent":
+            return self._launch_persistent(params_batch, steps, slot)
         nblocks = self.nblocks * steps
         if self.fan is not None:
             from ..parallel import fan_search_devices
@@ -856,6 +1063,62 @@ class JaxWorkBackend(WorkBackend):
         else:
             out = search.search_chunk_batch(pj, chunk_size=self.chunk * steps)
         return self._offsets_to_nonces(params_batch, np.asarray(out))
+
+    def _launch_persistent(
+        self, params_batch: np.ndarray, steps: int, slot: int
+    ) -> tuple:
+        """One blocking PERSISTENT launch: a device-resident while_loop of
+        ``steps`` windows (ops/runloop.py) that polls control slot ``slot``
+        every ``control_poll_steps`` windows and returns only on win,
+        cancel or span end. Same (lo, hi) absolute-nonce contract as the
+        chunked ``_launch`` on every gang flavor; the per-window geometry
+        (``self.chunk``) is identical, so ``span = chunk * steps`` and the
+        warm-shape ladder key (batch, steps) mean the same thing in both
+        modes — only the dispatch structure differs (one round trip per
+        REQUEST instead of per ``run_steps`` windows).
+        """
+        if self.fan is not None:
+            from ..parallel import fan_search_run_controlled
+
+            n = len(self.fan)
+            if params_batch.ndim == 2:
+                # Bare rows (setup self-test, warm probes): block-interleave
+                # from each row's own base, as the controlled fan scans
+                # contiguously per device.
+                params_batch = self._fan_stack_probe(
+                    params_batch, n, self.chunk_per_shard * steps
+                )
+            lo, hi = fan_search_run_controlled(
+                params_batch,
+                slot,
+                devices=self.fan,
+                chunk_per_shard=self.chunk_per_shard,
+                max_steps=steps,
+                poll_steps=self.control_poll_steps,
+                kernel=self.kernel,
+                sublanes=self.sublanes,
+                iters=self.iters,
+                nblocks=self.nblocks,
+                group=self.group,
+                interpret=self.interpret,
+            )
+            return lo, hi
+        # No mesh branch: persistent + shard_map mesh is refused at
+        # construction (SPMD control-poll divergence — see __init__).
+        lo, hi = runloop.search_run_batch_controlled(
+            jnp.asarray(params_batch),
+            None,
+            jnp.uint32(slot),
+            max_steps=steps,
+            poll_steps=self.control_poll_steps,
+            kernel=self.kernel,
+            sublanes=self.sublanes,
+            iters=self.iters,
+            nblocks=self.nblocks,
+            group=self.group,
+            interpret=self.interpret,
+        )
+        return np.asarray(lo), np.asarray(hi)
 
     @staticmethod
     def _offsets_to_nonces(params_batch: np.ndarray, offs: np.ndarray) -> tuple:
@@ -1062,8 +1325,14 @@ class JaxWorkBackend(WorkBackend):
         # pipeline*run_steps windows to ~run_steps + shared_steps_cap. The
         # rung's identity (cursor slot, job pool) keeps the UNCAPPED key.
         if (
-            speculative or inflight > 0 or len(rungs) > 1
-        ) and steps_want > self.shared_steps_cap:
+            (speculative or inflight > 0 or len(rungs) > 1)
+            and steps_want > self.shared_steps_cap
+            and self.run_mode != "persistent"
+        ):
+            # Persistent launches are exempt: the width demotion exists to
+            # bound how long queued work and cancels wait behind one launch,
+            # and the control channel bounds that at one poll interval —
+            # every persistent launch may run span-sized.
             steps_want = max(
                 s for s in self._step_counts() if s <= self.shared_steps_cap
             )
@@ -1111,8 +1380,18 @@ class JaxWorkBackend(WorkBackend):
                 # Per-device scan clocks start at the partition's first
                 # dispatch (all devices launch together in one fan pack).
                 j.dev_t0 = [self._clock.time()] * len(self.fan)
+        slot, launch_control = 0, None
+        if self.run_mode == "persistent":
+            # One control block per launch, slot-registered so the compiled
+            # program can route its polls by traced value; released when the
+            # launch's results are applied (a late straggler poll then reads
+            # dead zeros — the same fence as a killed row).
+            launch_control = ctl.LaunchControl(
+                b, clock=self._clock, n_dev=len(self.fan) if self.fan else 1
+            )
+            slot = ctl.register(launch_control)
         rec = _Launch(
-            fut=self._submit_launch(params, steps, timing),
+            fut=self._submit_launch(params, steps, timing, slot),
             jobs=active,
             # Snapshot targets and bases at launch: a concurrent dedup may
             # raise job.difficulty, and a pipelined successor dispatch will
@@ -1128,6 +1407,8 @@ class JaxWorkBackend(WorkBackend):
             # to fence stale launches out of frontier rewinds (plain) and
             # shard counters/clocks (fan).
             dev_epochs=[j.dev_epoch for j in active],
+            control=launch_control,
+            slot=slot,
         )
         span_dev = self.chunk_per_shard * steps
         for job, f in zip(active, factors):
@@ -1153,6 +1434,20 @@ class JaxWorkBackend(WorkBackend):
                 )
             if self.record_timeline:
                 self.timeline.append(("launch", timing))
+        if rec.control is not None:
+            # The launch is off the device: retire its control slot (a
+            # straggler poll now reads dead zeros) and export what the
+            # channel saw — launch length, polls, commands delivered and
+            # their issue→delivery latency on the injectable clock.
+            ctl.release(rec.slot)
+            c = rec.control
+            self._m_p_polls.inc(c.polls)
+            self._m_p_windows.observe(
+                min(c.last_k + self.control_poll_steps, rec.shape[1])
+            )
+            for _row, action, latency, _token in c.delivered:
+                self._m_p_control.inc(1, action)
+                self._m_p_effect.observe(latency)
         for job, f in zip(rec.jobs, rec.miss_factors):
             # This launch is no longer in flight: undo its coverage factor
             # (clamped — repeated multiply/divide may drift past 1.0).
@@ -1172,6 +1467,9 @@ class JaxWorkBackend(WorkBackend):
 
     def _record_solve(self, job: _Job, work: str) -> None:
         """Shared per-solve bookkeeping (plain and fan apply paths)."""
+        # Persistent successors still scanning the solved job exit within
+        # one poll interval instead of grinding their span out.
+        self._control_cancel_job(job)
         self.total_solutions += 1
         self._m_solutions.inc(1, "jax")
         self._tracer.mark_hash(job.block_hash, "device")
@@ -1193,14 +1491,34 @@ class JaxWorkBackend(WorkBackend):
 
     def _apply_plain_rows(self, rec: "_Launch", lo_arr, hi_arr) -> int:
         applied_hashes = 0
-        for job, launched, base, epoch, lo, hi in zip(
+        for i, (job, launched, base, epoch, lo, hi) in enumerate(zip(
             rec.jobs, rec.launched_difficulty, rec.bases, rec.dev_epochs,
             lo_arr[: len(rec.jobs)], hi_arr[: len(rec.jobs)],
-        ):
+        )):
+            if rec.control is not None:
+                # Mid-launch control re-aimed what the dispatch snapshot
+                # says: a DELIVERED rebase moved the row's base (and epoch)
+                # and a delivered raise moved the judged target — results
+                # must be read against what the device actually ran.
+                eb = rec.control.effective_base(i)
+                if eb is not None:
+                    base = eb
+                ed = rec.control.effective_difficulty(i)
+                if ed is not None:
+                    launched = ed
+                epoch = rec.control.effective_epoch(i, epoch)
             nonce = (int(hi) << 32) | int(lo)
-            if nonce == _MASK64:  # span exhausted without a hit
-                self.total_hashes += rec.span
-                applied_hashes += rec.span
+            if nonce == _MASK64:  # span exhausted, cancelled, or dry
+                span_i = rec.span
+                if rec.control is not None:
+                    # A cancelled row exited early: count the windows the
+                    # device actually ran, not the full span.
+                    span_i = min(
+                        rec.span,
+                        rec.control.windows_run(i, rec.shape[1]) * self.chunk,
+                    )
+                self.total_hashes += span_i
+                applied_hashes += span_i
                 # base already advanced at dispatch — exactly the miss case
                 # the speculation assumed.
                 continue
@@ -1251,9 +1569,34 @@ class JaxWorkBackend(WorkBackend):
         for i, (job, launched, bases, epoch) in enumerate(zip(
             rec.jobs, rec.launched_difficulty, rec.dev_bases, rec.dev_epochs
         )):
+            # Mid-launch control is applied PER DEVICE: each fan device
+            # polls (and exits) independently, so a command counts only on
+            # the devices that actually observed it — a device that exited
+            # early keeps its dispatch base/target/epoch, or its results
+            # would be misread (garbage scanned counts against a base it
+            # never adopted, an old-target hit misjudged as a device bug,
+            # a stale weak hit rewinding a re-covered frontier).
+            launched_dev = [launched] * n
+            epoch_dev = [epoch] * n
+            dry_scan = [span_dev] * n
+            if rec.control is not None:
+                bases = list(bases)
+                for d in range(n):
+                    eb = rec.control.effective_base(i, d)
+                    if eb is not None:
+                        bases[d] = eb
+                    ed = rec.control.effective_difficulty(i, d)
+                    if ed is not None:
+                        launched_dev[d] = ed
+                    epoch_dev[d] = rec.control.effective_epoch(i, epoch, d)
+                    dry_scan[d] = min(
+                        span_dev,
+                        rec.control.windows_run(i, rec.shape[1], d)
+                        * self.chunk_per_shard,
+                    )
             # Per-device results for this row: (local offset, device, nonce).
             cands = []
-            row_scanned = [span_dev] * n
+            row_scanned = list(dry_scan)
             for d in range(n):
                 nonce = (int(hi_arr[d, i]) << 32) | int(lo_arr[d, i])
                 if nonce == _MASK64:
@@ -1261,15 +1604,28 @@ class JaxWorkBackend(WorkBackend):
                 local = (nonce - bases[d]) & _MASK64
                 row_scanned[d] = local + 1
                 cands.append((local, d, nonce))
+            hit_devs = {d for _l, d, _n in cands}
             for d in range(n):
                 per_dev_scanned[d] += row_scanned[d]
                 applied_hashes += row_scanned[d]
                 self.total_hashes += row_scanned[d]
-                if job.dev_scanned is not None and epoch == job.dev_epoch:
+                if job.dev_scanned is not None and epoch_dev[d] == job.dev_epoch:
                     # Same-partition results only: a cover_range rebase
                     # while this launch was on the wire reset the shard
                     # counters, and the old span must not inflate them.
-                    job.dev_scanned[d] += row_scanned[d]
+                    # For a device that ADOPTED the rebase mid-launch and
+                    # then ran dry, subtract the windows it scanned in the
+                    # OLD partition before applying (a hit's row_scanned
+                    # is already relative to the rebased base).
+                    credit = row_scanned[d]
+                    if rec.control is not None and d not in hit_devs:
+                        credit = max(
+                            0,
+                            credit
+                            - rec.control.applied_at_k(i, d)
+                            * self.chunk_per_shard,
+                        )
+                    job.dev_scanned[d] += credit
             if job.future.done() or not cands:
                 continue
             cands.sort()  # fewest-nonces-scanned first, device as tiebreak
@@ -1278,18 +1634,18 @@ class JaxWorkBackend(WorkBackend):
                 value = nc.work_value(job.block_hash, work)
                 if value >= job.difficulty:
                     self._record_solve(job, work)
-                    self._attribute_win(job, d, epoch)
+                    self._attribute_win(job, d, epoch_dev[d])
                     break
-                elif value >= launched:
-                    # Valid at the launched target but raised mid-flight:
-                    # ONLY the device that produced the weak hit resumes
-                    # past it — its siblings' shards are untouched. Both
-                    # policies skip the rewind when the job was
-                    # re-partitioned while this launch was on the wire
-                    # (epoch mismatch): rewinding would drag the frontier
-                    # back into the OLD region and undo a cover_range
-                    # re-cover.
-                    if epoch == job.dev_epoch:
+                elif value >= launched_dev[d]:
+                    # Valid at the target device d was actually holding the
+                    # row to, but raised past it meanwhile: ONLY the device
+                    # that produced the weak hit resumes past it — its
+                    # siblings' shards are untouched. Both policies skip
+                    # the rewind when the job was re-partitioned while this
+                    # launch was on the wire (epoch mismatch): rewinding
+                    # would drag the frontier back into the OLD region and
+                    # undo a cover_range re-cover.
+                    if epoch_dev[d] == job.dev_epoch:
                         if job.dev_bases is not None:
                             job.dev_bases[d] = (nonce + 1) & _MASK64
                         else:
@@ -1299,7 +1655,7 @@ class JaxWorkBackend(WorkBackend):
                         WorkError(
                             f"device produced invalid work {work} for "
                             f"{job.block_hash} "
-                            f"(value {value:016x} < {launched:016x})"
+                            f"(value {value:016x} < {launched_dev[d]:016x})"
                         )
                     )
                     break
@@ -1364,7 +1720,11 @@ class JaxWorkBackend(WorkBackend):
                 )
 
     async def _engine_loop_inner(self) -> None:
-        inflight: deque = deque()
+        # Instance-held so the persistent control writers can reach running
+        # launches; cleared on (re)start — a crashed predecessor's records
+        # are abandoned with their jobs.
+        inflight = self._inflight
+        inflight.clear()
         try:
             await self._engine_loop_body(inflight)
         finally:
@@ -1375,6 +1735,23 @@ class JaxWorkBackend(WorkBackend):
             for r in inflight:
                 if r.waiter is not None:
                     r.waiter.cancel()
+                if r.control is not None:
+                    # A launch abandoned mid-flight (close, crash, timeout)
+                    # never reaches _apply_results. Cancel every row so the
+                    # orphan thread exits at its next poll instead of
+                    # grinding the span out, then retire the slot once the
+                    # thread actually returns (releasing before it polls
+                    # would feed it dead zeros and UNDO the cancel; release
+                    # is idempotent, so the happy path's release is safe).
+                    for i in range(len(r.jobs)):
+                        r.control.cancel(i)
+
+                    def _retire(f, s=r.slot):
+                        ctl.release(s)
+                        if not f.cancelled():
+                            f.exception()  # consume an abandoned failure
+
+                    r.fut.add_done_callback(_retire)
 
     async def _engine_loop_body(self, inflight: deque) -> None:
         while not self._closed:

@@ -115,6 +115,9 @@ class DpowClient:
             kwargs["device_shard"] = config.device_shard
             if config.run_steps > 0:
                 kwargs["run_steps"] = config.run_steps
+            kwargs["run_mode"] = config.run_mode
+            if config.control_poll_steps > 0:
+                kwargs["control_poll_steps"] = config.control_poll_steps
             if config.pipeline > 0:
                 kwargs["pipeline"] = config.pipeline
             kwargs["step_ladder"] = config.step_ladder

@@ -39,6 +39,16 @@ class ClientConfig:
     # frontier dealt round-robin per launch window (mesh-gang coverage order).
     device_shard: str = "split"
     run_steps: int = 0  # 0 = auto; windows per device launch (backend=jax)
+    # Launch structure (backend=jax): 'chunked' bounds every launch at
+    # run_steps windows so cancels apply at relaunch boundaries;
+    # 'persistent' runs span-sized device-resident launches that poll a
+    # host control channel mid-launch (cancel/raise/cover_range land within
+    # one poll interval; one host round trip per request).
+    run_mode: str = "chunked"
+    # Persistent mode: windows between control polls (0 = auto: 8 on TPU,
+    # 1 elsewhere). One poll interval is the worst-case mid-launch
+    # cancel/raise/rebase latency; each poll is a host touch.
+    control_poll_steps: int = 0
     pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
     step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
     shared_steps_cap: int = 0  # 0 = auto (run_steps/4); windows/launch under contention
@@ -87,6 +97,10 @@ class ClientConfig:
             )
         if self.device_shard not in ("split", "interleave"):
             raise ValueError("--device_shard must be 'split' or 'interleave'")
+        if self.run_mode not in ("chunked", "persistent"):
+            raise ValueError("--run_mode must be 'chunked' or 'persistent'")
+        if self.control_poll_steps < 0:
+            raise ValueError("--control_poll_steps must be >= 0 (0 = auto)")
         if self.pipeline < 0:
             raise ValueError("--pipeline must be >= 0 (0 = auto)")
         if self.shared_steps_cap < 0:
@@ -166,6 +180,20 @@ def parse_args(argv=None) -> ClientConfig:
                    "auto: device-resident runs on TPU, single windows "
                    "elsewhere; higher = less dispatch overhead, coarser "
                    "cancel latency)")
+    p.add_argument("--run_mode", default=c.run_mode,
+                   choices=["chunked", "persistent"],
+                   help="launch structure (backend=jax): 'chunked' bounds "
+                   "launches at --run_steps windows and applies cancels at "
+                   "relaunch boundaries; 'persistent' runs span-sized "
+                   "device-resident launches steered mid-flight through a "
+                   "control channel (cancel/raise/re-cover land within one "
+                   "poll interval, one host round trip per request)")
+    p.add_argument("--control_poll_steps", type=int,
+                   default=c.control_poll_steps,
+                   help="persistent mode: windows between mid-launch control "
+                   "polls (0 = auto: 8 on TPU, 1 elsewhere; one interval is "
+                   "the worst-case mid-launch cancel latency, each poll is "
+                   "a host touch)")
     p.add_argument("--pipeline", type=int, default=c.pipeline,
                    help="device launches in flight at once (backend=jax; "
                    "0 = auto: 2 — overlaps readback of one launch with "

@@ -36,7 +36,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import io_callback
 
+from . import control as ctl
 from . import pallas_kernel, search
 from .search import BASE_LO, BASE_HI, SENTINEL
 
@@ -53,6 +55,8 @@ def run_loop_core(
     launch,
     window,
     max_steps: int,
+    control_poll=None,
+    poll_steps: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The shared multi-window while_loop: trace-time building block.
 
@@ -63,6 +67,16 @@ def run_loop_core(
     :func:`tpu_dpow.parallel.sharded_search_run` so the subtle parts —
     found-masking, pinning solved rows at their winning nonce, zeroing
     padding rows' difficulty — live in exactly one place.
+
+    With ``control_poll`` set (a traced ``(k, done) -> uint32[B, CTRL_WORDS]``
+    callback — ops/control.py's io_callback wrapper), the loop becomes the
+    PERSISTENT flavor: every ``poll_steps`` windows it reads host-updatable
+    control state and reacts MID-LAUNCH — cancel exits the row (difficulty
+    drops to 0 so the lanes free after one tile group; the row returns the
+    all-ones UNSOLVED marker), raise swaps the target in place, rebase
+    re-aims the frontier. The launch then returns only on win, cancel or
+    span end, so ``max_steps`` can be span-sized without coupling cancel
+    latency to launch length.
     """
 
     def step(state):
@@ -103,8 +117,74 @@ def run_loop_core(
         pb = pb.at[:, search.DIFF_HI].set(
             jnp.where(active, pb[:, search.DIFF_HI], zero)
         )
-    init = (jnp.int32(0), pb, ones, ones, done0)
-    _, _, lo, hi, _ = lax.while_loop(cond, step, init)
+    if control_poll is None:
+        init = (jnp.int32(0), pb, ones, ones, done0)
+        _, _, lo, hi, _ = lax.while_loop(cond, step, init)
+        return lo, hi
+
+    # Persistent flavor: an outer loop of poll blocks around the same
+    # inner window loop. The poll runs at the START of each block, so a
+    # command written during block k takes effect at block k+1 — worst-
+    # case poll-to-effect is one poll interval (poll_steps windows).
+    # io_callback cannot sit inside lax.cond (effect rules), which is why
+    # the cadence is a nested loop rather than a `k % poll_steps` branch.
+    poll_steps = max(1, int(poll_steps))
+
+    def inner_cond(state):
+        k, j, _, _, _, done = state
+        return (j < poll_steps) & (k < max_steps) & ~jnp.all(done)
+
+    def inner_step(state):
+        k, j, params, lo, hi, done = state
+        k, params, lo, hi, done = step((k, params, lo, hi, done))
+        return k, j + 1, params, lo, hi, done
+
+    def outer_step(state):
+        k, params, lo, hi, done, seq = state
+        ctrl = control_poll(k, done)
+        flags = ctrl[:, ctl.IDX_FLAGS]
+        live = ~done
+        cancel = live & ((flags & ctl.FLAG_CANCEL) != 0)
+        fresh = live & (ctrl[:, ctl.IDX_SEQ] != seq) & ~cancel
+        do_raise = fresh & ((flags & ctl.FLAG_RAISE) != 0)
+        do_rebase = fresh & ((flags & ctl.FLAG_REBASE) != 0)
+        zero = jnp.uint32(0)
+        params = params.at[:, search.DIFF_LO].set(
+            jnp.where(
+                cancel, zero,
+                jnp.where(do_raise, ctrl[:, ctl.IDX_DIFF_LO],
+                          params[:, search.DIFF_LO]),
+            )
+        )
+        params = params.at[:, search.DIFF_HI].set(
+            jnp.where(
+                cancel, zero,
+                jnp.where(do_raise, ctrl[:, ctl.IDX_DIFF_HI],
+                          params[:, search.DIFF_HI]),
+            )
+        )
+        params = params.at[:, BASE_LO].set(
+            jnp.where(do_rebase, ctrl[:, ctl.IDX_BASE_LO], params[:, BASE_LO])
+        )
+        params = params.at[:, BASE_HI].set(
+            jnp.where(do_rebase, ctrl[:, ctl.IDX_BASE_HI], params[:, BASE_HI])
+        )
+        # A cancelled row is done (exits the loop) but stays pinned at the
+        # all-ones unsolved marker — the zeroed difficulty keeps its lanes
+        # nearly free for any windows its batch siblings still need.
+        done = done | cancel
+        seq = jnp.where(fresh, ctrl[:, ctl.IDX_SEQ], seq)
+        k, _, params, lo, hi, done = lax.while_loop(
+            inner_cond, inner_step, (k, jnp.int32(0), params, lo, hi, done)
+        )
+        return k, params, lo, hi, done, seq
+
+    def outer_cond(state):
+        k, _, _, _, done, _ = state
+        return (k < max_steps) & ~jnp.all(done)
+
+    init = (jnp.int32(0), pb, ones, ones, done0, jnp.zeros((b,), jnp.uint32))
+    _, _, lo, hi, _, _ = lax.while_loop(outer_cond, outer_step, init)
     return lo, hi
 
 
@@ -159,4 +239,76 @@ def search_run_batch(
 
     return run_loop_core(
         params_batch, active, launch=launch, window=window, max_steps=max_steps
+    )
+
+
+def make_control_poll(slot, *, dev=0):
+    """The traced control poll for :func:`run_loop_core`: an unordered
+    ``io_callback`` into ops/control.py's slot table.
+
+    ``slot`` is a TRACED scalar (the launch's slot id), so one compiled
+    program serves every launch of the same shape — the callback routes by
+    value at run time. ``dev`` is the fan axis index (0 on the plain path);
+    passing ``k`` and the live ``done`` mask makes the callback loop-variant
+    (it cannot be hoisted out of the while_loop) and gives the host the
+    delivery bookkeeping it mirrors (ops/control.py ``poll``).
+    """
+
+    def control_poll(k, done):
+        return io_callback(
+            ctl.poll_slot,
+            jax.ShapeDtypeStruct((done.shape[0], ctl.CTRL_WORDS), jnp.uint32),
+            slot, dev, k, done,
+            ordered=False,
+        )
+
+    return control_poll
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_steps", "poll_steps", "kernel", "sublanes", "iters", "nblocks",
+        "group", "interpret", "unroll",
+    ),
+)
+def search_run_batch_controlled(
+    params_batch: jnp.ndarray,
+    active: Optional[jnp.ndarray],
+    slot: jnp.ndarray,
+    *,
+    max_steps: int,
+    poll_steps: int,
+    kernel: str = "pallas",
+    sublanes: int = pallas_kernel.DEFAULT_SUBLANES,
+    iters: int = pallas_kernel.DEFAULT_ITERS,
+    nblocks: int = 1,
+    group: int = 1,
+    interpret: bool = False,
+    unroll: Optional[bool] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`search_run_batch` with a live control channel: the PERSISTENT
+    single-chip launch. Identical window contract, but the loop polls slot
+    ``slot``'s host control block every ``poll_steps`` windows and applies
+    cancel/raise/rebase mid-launch, so ``max_steps`` can span the whole
+    request (one host round trip per REQUEST) while cancel latency stays
+    one poll interval. ``slot`` is traced — one compile per (batch,
+    max_steps, poll_steps) shape, reused by every launch.
+    """
+    window = sublanes * 128 * iters * nblocks
+    if window >= 1 << 31:
+        raise ValueError("per-step window must stay below 2^31 nonces")
+
+    def launch(params: jnp.ndarray) -> jnp.ndarray:
+        if kernel == "pallas":
+            return pallas_kernel.pallas_search_chunk_batch(
+                params, sublanes=sublanes, iters=iters, nblocks=nblocks,
+                group=group, interpret=interpret, unroll=unroll,
+            )
+        return search.search_chunk_batch(params, chunk_size=window, unroll=unroll)
+
+    return run_loop_core(
+        params_batch, active, launch=launch, window=window,
+        max_steps=max_steps, control_poll=make_control_poll(slot),
+        poll_steps=poll_steps,
     )

@@ -13,6 +13,7 @@ from .fan_search import (  # noqa: F401
     fan_search_chunk_batch,
     fan_search_devices,
     fan_search_run,
+    fan_search_run_controlled,
     has_shard_map,
 )
 from .mesh_search import (  # noqa: F401
@@ -23,6 +24,7 @@ from .mesh_search import (  # noqa: F401
     replicate_params,
     sharded_search_chunk_batch,
     sharded_search_run,
+    sharded_search_run_controlled,
 )
 from .multihost import (  # noqa: F401
     arrange_by_host,

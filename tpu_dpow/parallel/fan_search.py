@@ -231,6 +231,97 @@ def fan_search_devices(
 
 
 @functools.lru_cache(maxsize=None)
+def _fan_controlled_fn(
+    devices: tuple, chunk_per_shard: int, max_steps: int, poll_steps: int,
+    stride: int, kernel: str, sublanes: int, iters: int, nblocks: int,
+    group: int, interpret: bool,
+):
+    def dev_fn(p_local: jnp.ndarray, active: jnp.ndarray, slot: jnp.ndarray):
+        idx = lax.axis_index(FAN_AXIS)
+
+        def launch(params: jnp.ndarray) -> jnp.ndarray:
+            return _local_scan(
+                params, chunk_per_shard=chunk_per_shard, kernel=kernel,
+                sublanes=sublanes, iters=iters, nblocks=nblocks, group=group,
+                interpret=interpret,
+            )
+
+        return runloop.run_loop_core(
+            p_local, active, launch=launch, window=stride,
+            max_steps=max_steps,
+            control_poll=runloop.make_control_poll(slot, dev=idx),
+            poll_steps=poll_steps,
+        )
+
+    return jax.pmap(
+        dev_fn, axis_name=FAN_AXIS, devices=devices, in_axes=(0, 0, None)
+    )
+
+
+def fan_search_run_controlled(
+    stacked_params: np.ndarray,
+    slot: int,
+    *,
+    devices: Sequence[jax.Device],
+    chunk_per_shard: int,
+    max_steps: int,
+    poll_steps: int,
+    stride: Optional[int] = None,
+    active: Optional[np.ndarray] = None,
+    kernel: str = "xla",
+    sublanes: int = pallas_kernel.DEFAULT_SUBLANES,
+    iters: int = pallas_kernel.DEFAULT_ITERS,
+    nblocks: int = 1,
+    group: int = 1,
+    interpret: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The PERSISTENT fan launch: per-device multi-window search with a live
+    control channel — uint32[D,B,12] caller-baked bases in, per-device
+    absolute (lo, hi) uint32[D,B] nonces out (all-ones unsolved/cancelled).
+
+    The engine twin of :func:`fan_search_devices`: no collective, the host
+    elects the winner and keeps the attribution. Every device polls the
+    SAME control slot every ``poll_steps`` windows with its own fan index,
+    so ops/control.py can hand each device its own rebase base (a fleet
+    cover_range re-partitions all device shards mid-launch — the PR-6
+    idiom without the relaunch). ``stride`` is each device's per-window
+    frontier advance: ``chunk_per_shard`` for contiguous 'split' macro-
+    ranges (the default), ``chunk_per_shard * n_devices`` for 'interleave'
+    (caller bakes the initial ``d * chunk_per_shard`` stagger into the
+    base words, exactly as at dispatch time).
+    """
+    devs = tuple(devices)
+    n = len(devs)
+    if stacked_params.shape[0] != n:
+        raise ValueError(
+            f"stacked params lead axis {stacked_params.shape[0]} != {n} fan devices"
+        )
+    if kernel == "pallas" and chunk_per_shard != sublanes * 128 * iters * nblocks:
+        raise ValueError(
+            "pallas kernel: chunk_per_shard must equal sublanes*128*iters*nblocks"
+        )
+    if stride is None:
+        stride = chunk_per_shard
+    if stride >= 1 << 31:
+        raise ValueError("per-window stride must stay below 2^31 nonces")
+    b = stacked_params.shape[1]
+    if active is None:
+        act = np.ones((n, b), dtype=bool)
+    else:
+        act = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(active, dtype=bool), (n, b))
+        )
+    fn = _fan_controlled_fn(
+        devs, chunk_per_shard, max_steps, poll_steps, stride, kernel,
+        sublanes, iters, nblocks, group, interpret,
+    )
+    lo, hi = fn(
+        jnp.asarray(stacked_params), jnp.asarray(act), jnp.uint32(slot)
+    )
+    return np.asarray(lo), np.asarray(hi)
+
+
+@functools.lru_cache(maxsize=None)
 def _fan_run_fn(
     devices: tuple, chunk_per_shard: int, max_steps: int, kernel: str,
     sublanes: int, iters: int, nblocks: int, group: int, interpret: bool,

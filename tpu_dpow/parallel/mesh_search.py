@@ -198,6 +198,71 @@ def sharded_search_run(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "chunk_per_shard", "max_steps", "poll_steps", "kernel",
+        "sublanes", "iters", "nblocks", "group", "interpret",
+    ),
+)
+def sharded_search_run_controlled(
+    params_batch: jnp.ndarray,
+    active: Optional[jnp.ndarray],
+    slot: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    chunk_per_shard: int,
+    max_steps: int,
+    poll_steps: int,
+    kernel: str = "xla",
+    sublanes: int = pallas_kernel.DEFAULT_SUBLANES,
+    iters: int = pallas_kernel.DEFAULT_ITERS,
+    nblocks: int = 1,
+    group: int = 1,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`sharded_search_run` with a live control channel — the
+    PERSISTENT mesh launch (jax >= 0.6, capability-gated like the rest of
+    the shard_map path; the fan twin is
+    ``parallel.fan_search.fan_search_run_controlled``).
+
+    SPMD caveat (why the engine refuses mesh+persistent): on a REAL
+    multi-device mesh every device executes this program — including the
+    control poll — independently, while the host mutates the control
+    block concurrently; two devices can observe a command at different
+    poll blocks, diverge in while_loop trip count, and deadlock the next
+    collective. Safe on a one-device mesh (the gang-machinery A/B); the
+    multi-device fix is pinning the poll to one device and broadcasting
+    (``io_callback(..., sharding=)``) — to be validated when a jax >= 0.6
+    image can actually run the mesh.
+
+    The loop structure is identical to :func:`sharded_search_run` — the
+    while_loop sits OUTSIDE the shard_map and every window's ganged launch
+    re-applies each shard's ``idx * chunk_per_shard`` interleave offset to
+    the current request-level base — so the control channel needs no
+    per-shard staggering: a rebase rewrites the replicated base words and
+    the next window's launch shards the new region exactly as the first
+    window sharded the old one. Control polls carry ``dev=0`` (the gang is
+    one logical frontier; per-device attribution is the fan's concern).
+    """
+    n_nonce = mesh.shape[NONCE_AXIS]
+    global_chunk = chunk_per_shard * n_nonce
+
+    def launch(params: jnp.ndarray) -> jnp.ndarray:
+        return sharded_search_chunk_batch(
+            params, mesh=mesh, chunk_per_shard=chunk_per_shard, kernel=kernel,
+            sublanes=sublanes, iters=iters, nblocks=nblocks, group=group,
+            interpret=interpret,
+        )
+
+    return runloop.run_loop_core(
+        params_batch, active, launch=launch, window=global_chunk,
+        max_steps=max_steps,
+        control_poll=runloop.make_control_poll(slot),
+        poll_steps=poll_steps,
+    )
+
+
 def expected_steps(difficulty: int, *, chunk_per_shard: int, n_nonce: int) -> int:
     """Median number of ganged windows to a solution at this difficulty."""
     p = (2**64 - difficulty) / 2**64
