@@ -7,6 +7,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The device-failure scenario wants a multi-device fan; on a CPU-only box
+# force 8 virtual devices (must land before the first jax import — the
+# tests/conftest.py trick).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 from tpu_dpow.scripts.chaos_demo import main  # noqa: E402
 
 if __name__ == "__main__":

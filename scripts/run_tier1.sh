@@ -54,6 +54,14 @@ PERSISTENT=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_persistent.py tests/test_backend.py -k persistent \
     --collect-only -q -p no:cacheprovider 2>/dev/null | grep -c '::' || true)
 echo "PERSISTENT=${PERSISTENT}"
+# Device fault-domain coverage at a glance (ISSUE 12): watchdog /
+# evacuation / quarantine / bounded-close tests plus the workserver
+# subprocess close-bound pins. Collection only — does not rerun anything.
+DEVFAULT=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_devfault.py tests/test_workserver.py -k \
+    'devfault or device or workserver_process' \
+    --collect-only -q -p no:cacheprovider 2>/dev/null | grep -c '::' || true)
+echo "DEVFAULT=${DEVFAULT}"
 # dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
 # or how many findings escaped the baseline (docs/analysis.md).
 DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)
@@ -77,7 +85,9 @@ fi
 # DPOW_SAN_SEEDS degrades to the default here exactly as it does for
 # python -m tpu_dpow.analysis --san.
 SAN_SEEDS=$(python -c "from tpu_dpow.analysis.sanitizer import _env_int; print(_env_int('DPOW_SAN_SEEDS', 20))" 2>/dev/null || echo 20)
-DPOWSAN_OUT=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -c "
+# (timeout covers four scenarios since ISSUE 12 added devfault — the jax
+# engine replay costs ~1s/seed on this box after the first compile)
+DPOWSAN_OUT=$(timeout -k 10 420 env JAX_PLATFORMS=cpu python -c "
 import sys
 from tpu_dpow.analysis import sanitizer
 report = sanitizer.run_seeds(sanitizer._env_int('DPOW_SAN_SEEDS', 20))

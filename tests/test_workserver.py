@@ -120,3 +120,61 @@ def test_workserver_with_native_backend():
             await server.stop()
 
     asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_workserver_process_stop_kills_sigterm_ignoring_child():
+    """Managed-subprocess close path (ISSUE 12 satellite): a work-server
+    child that IGNORES terminate must be killed within the close bound —
+    never awaited forever. (The PR-8 detach-then-await hardening covered
+    tasks; this pins the subprocess wait itself.)"""
+    import sys
+    import time
+
+    from tpu_dpow.workserver import WorkServerProcess
+
+    stubborn = (
+        "import signal, time; "
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+        "print('up', flush=True); time.sleep(600)"
+    )
+
+    async def run():
+        mgr = WorkServerProcess(
+            [sys.executable, "-c", stubborn],
+            terminate_grace=0.5, kill_grace=10.0,
+        )
+        await mgr.start()
+        assert mgr.pid is not None
+        await asyncio.sleep(0.3)  # let the child install its handler
+        t0 = time.monotonic()
+        confirmed = await mgr.stop()
+        elapsed = time.monotonic() - t0
+        assert confirmed, "child must be confirmed dead after escalation"
+        assert elapsed < 8.0, f"stop() took {elapsed:.1f}s — not bounded"
+        assert elapsed >= 0.4, "child ignored SIGTERM; kill escalation ran"
+        # idempotent: a second stop is a no-op
+        assert await mgr.stop()
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_workserver_process_stop_cooperative_child_is_fast():
+    """A child that honors SIGTERM exits inside terminate_grace — no kill
+    escalation, stop() returns promptly."""
+    import sys
+    import time
+
+    from tpu_dpow.workserver import WorkServerProcess
+
+    async def run():
+        mgr = WorkServerProcess(
+            [sys.executable, "-c", "import time; time.sleep(600)"],
+            terminate_grace=5.0, kill_grace=5.0,
+        )
+        await mgr.start()
+        await asyncio.sleep(0.2)
+        t0 = time.monotonic()
+        assert await mgr.stop()
+        assert time.monotonic() - t0 < 4.0
+
+    asyncio.run(asyncio.wait_for(run(), timeout=30))

@@ -59,6 +59,8 @@ INSTRUMENTED_PREFIXES = (
     "tpu_dpow/resilience/",
     "tpu_dpow/transport/broker.py",
     "tpu_dpow/transport/inproc.py",
+    "tpu_dpow/backend/jax_backend.py",
+    "tpu_dpow/ops/control.py",
 )
 
 
@@ -94,8 +96,9 @@ def add_flags(p: argparse.ArgumentParser) -> None:
     c = SanitizerConfig()
     p.add_argument(
         "--san", action="store_true",
-        help="after the static pass, replay the coalescing and fleet "
-        "re-cover scenarios under the seeded interleaving perturber",
+        help="after the static pass, replay the coalescing, fleet "
+        "re-cover, replica-takeover and device-fault scenarios under "
+        "the seeded interleaving perturber",
     )
     p.add_argument(
         "--san_seeds", type=int,
@@ -645,10 +648,122 @@ async def scenario_takeover(perturber: Perturber) -> None:
         await b.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario: device fault domains — evacuate vs solve vs cancel vs raise
+# ---------------------------------------------------------------------------
+
+
+async def scenario_devfault(perturber: Perturber) -> None:
+    """The jax engine's device fault domains under seed-shuffled
+    interleavings of the four things that can race a wedged device:
+    the WATCHDOG's evacuation/exhaustion sweep, the SOLVE (the zombie
+    wake-up releasing a launch that may already hold the winner), a
+    CANCEL, and a RAISE — in every order the seed picks, at perturbed
+    yield points. Invariants: the request is served with host-valid work
+    or fails CLEANLY (WorkCancelled / DevicesExhausted — never stranded),
+    the engine tears down to zero jobs, and the wedged thread always
+    drains once the fault lifts (no leaked control slots)."""
+    from ..backend import DevicesExhausted, WorkCancelled
+    from ..backend.jax_backend import JaxWorkBackend
+    from ..chaos import FaultyDevice
+    from ..models import WorkRequest
+    from ..ops import control as ctl_mod
+    from ..resilience.clock import FakeClock
+    from ..utils import nanocrypto as nc
+
+    rng = perturber.rng
+    unreachable = (1 << 64) - 2
+    difficulty = EASY_DIFFICULTY if rng.random() < 0.5 else unreachable
+    hang_window = rng.randint(1, 3)
+    do_raise = difficulty == EASY_DIFFICULTY and rng.random() < 0.4
+    do_cancel = rng.random() < 0.4
+    do_advance = rng.random() < 0.6
+    if difficulty != EASY_DIFFICULTY or do_raise:
+        # an unreachable (or raised-unreachable) target can only end via
+        # cancel or exhaustion: keep every seed bounded
+        do_cancel = True
+
+    actions = ["release"]
+    if do_cancel:
+        actions.append("cancel")
+    if do_raise:
+        actions.append("raise")
+    if do_advance:
+        actions.append("advance")
+    rng.shuffle(actions)
+
+    clock = FakeClock()
+    b = JaxWorkBackend(
+        kernel="xla", sublanes=8, iters=8, run_mode="persistent",
+        persistent_steps=4, control_poll_steps=1, pipeline=1, clock=clock,
+        device_suspect_after=5.0, device_probe_interval=10.0,
+    )
+    await b.setup()
+    h = _scenario_hash(perturber.seed, "devfault")
+    fd = FaultyDevice()
+    fd.install()
+    try:
+        fd.hang_at_poll(0, hang_window)
+        task = asyncio.ensure_future(b.generate(WorkRequest(h, difficulty)))
+        # let the launch engage (real time; the engine clock stays frozen)
+        for _ in range(2000):
+            if fd.events or task.done():
+                break
+            await asyncio.sleep(0.002)
+        raised = False
+        for action in actions:
+            await perturber.point(f"devfault.{action}")
+            if action == "release":
+                fd.release(0)
+            elif action == "cancel":
+                await b.cancel(h)
+            elif action == "raise":
+                # a raise landing after the solve is a legitimate no-op —
+                # only a raise that TOOK moves the bar the result must meet
+                raised = await b.raise_difficulty(h, unreachable)
+            elif action == "advance":
+                await clock.advance(7.0)
+        await perturber.point("devfault.settle")
+        try:
+            result = await asyncio.wait_for(task, timeout=60)
+        except (WorkCancelled, DevicesExhausted):
+            result = None  # clean abort
+        if result is not None:
+            final = unreachable if raised else difficulty
+            if nc.work_value(h, result) < final:
+                raise SanitizerFailure(
+                    f"served work {result} below the final target"
+                )
+        # the wedged thread must drain once the fault is lifted
+        for rec in list(b._inflight):
+            if rec.thread_done is not None:
+                for _ in range(5000):
+                    if rec.thread_done.is_set():
+                        break
+                    await asyncio.sleep(0.002)
+                else:
+                    raise SanitizerFailure("launch thread never drained")
+        await b.close()
+        if b._jobs:
+            raise SanitizerFailure(f"jobs leaked past close: {b._jobs}")
+        for _ in range(2000):
+            with ctl_mod._slots_lock:
+                leaked = list(ctl_mod._slots)
+            if not leaked:
+                break
+            await asyncio.sleep(0.002)
+        else:
+            raise SanitizerFailure(f"control slots leaked: {leaked}")
+    finally:
+        fd.uninstall()
+        await b.close()
+
+
 SCENARIOS: Dict[str, Callable] = {
     "coalesce": scenario_coalesce,
     "fleet_recover": scenario_fleet_recover,
     "takeover": scenario_takeover,
+    "devfault": scenario_devfault,
 }
 
 

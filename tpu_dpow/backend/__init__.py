@@ -33,6 +33,15 @@ class WorkCancelled(WorkError):
     """The in-flight request was cancelled (reference work_cancel analog)."""
 
 
+class DevicesExhausted(WorkError):
+    """Every device in the engine's fault domain is quarantined: the
+    engine KNOWS it cannot serve (docs/resilience.md "Device fault
+    domains"). Distinct from a plain WorkError so the failover chain
+    (resilience/failover.py) can escalate immediately — trip the engine's
+    breaker outright instead of probing a backend that has already
+    declared itself dead — and count the cause separately from a hang."""
+
+
 async def await_shared_job(job, abort: Callable[[], None]) -> str:
     """Wait on a shared (deduped) job with last-waiter-out cancellation.
 

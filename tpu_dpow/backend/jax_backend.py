@@ -49,6 +49,7 @@ from __future__ import annotations
 import asyncio
 import math
 import secrets
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -63,10 +64,36 @@ from ..models import WorkRequest
 from ..ops import control as ctl
 from ..ops import pallas_kernel, runloop, search
 from ..resilience.clock import Clock, SystemClock
+from ..resilience.devfault import (
+    DEADLINE_SLACK,
+    HEALTHY,
+    DeviceFaultDomains,
+    launch_deadline,
+)
 from ..utils import nanocrypto as nc
-from . import WorkBackend, WorkCancelled, WorkError, await_shared_job
+from . import DevicesExhausted, WorkBackend, WorkCancelled, WorkError, await_shared_job
 
 _MASK64 = (1 << 64) - 1
+
+
+def _consume_abandoned(fut) -> None:
+    """Done-callback tail for an abandoned launch future: consume its
+    outcome so an exception never logs as never-retrieved (a cancelled
+    wrapper has nothing to consume)."""
+    if not fut.cancelled():
+        fut.exception()
+
+
+def _retire_on_done(fut, slot: int) -> None:
+    """Attach the abandoned-launch retirement: when the future resolves,
+    release the control slot (idempotent — the launch thread's own
+    release normally got there first) and consume the outcome."""
+
+    def _retire(f, s=slot):
+        ctl.release(s)
+        _consume_abandoned(f)
+
+    fut.add_done_callback(_retire)
 
 # Coverage-aware dispatch (see _dispatch_next): a job is worth another span
 # while P(no in-flight span solves it) is at least this. Below it the job is
@@ -113,6 +140,10 @@ class _Job:
     # region a re-cover just left (the same inflation/undo the fleet's
     # per-shard scan stamps guard against).
     dev_epoch: int = 0
+    # The partition's recorded range (fan mode): evacuation computes a dead
+    # device's uncovered remainder against this end (length 0 = full span).
+    part_start: int = 0
+    part_len: int = 0
     # P(no launch currently in flight solves this job); 1.0 = uncovered.
     inflight_miss: float = 1.0
     # Timeline stamps (record_timeline only): submission and first dispatch.
@@ -167,6 +198,22 @@ class _Launch:
     # launches — they cannot be steered mid-flight.
     control: "Optional[ctl.LaunchControl]" = None
     slot: int = 0
+    # Fan mode: launch slice index -> PHYSICAL device index. A launch
+    # dispatched at degraded width (quarantined devices excluded) runs on
+    # a subset of the fan; every apply/attribution path maps through this.
+    fan_map: "Optional[list]" = None
+    # Dispatch stamp on the engine's injectable clock — the watchdog's
+    # progress-deadline anchor for a launch that has not polled yet.
+    t_clock: float = 0.0
+    # Set by the launch THREAD when it actually returns. ``fut`` cannot
+    # stand in for this: cancelling its waiter marks the asyncio wrapper
+    # done while the executor thread may still be wedged — and the close
+    # bound exists precisely to tell those two apart.
+    thread_done: "Optional[threading.Event]" = None
+    # Set when the watchdog ejects the launch from the pipeline (a suspect
+    # device pins it): its results are discarded, its control rows are
+    # kill-fenced, and the engine loop must not apply it.
+    abandoned: bool = False
 
 
 class JaxWorkBackend(WorkBackend):
@@ -215,6 +262,9 @@ class JaxWorkBackend(WorkBackend):
         step_ladder: str = "x4",  # run-length quantization: 'x4' | 'x2'
         shared_steps_cap: Optional[int] = None,  # windows/launch under contention
         clock: Optional[Clock] = None,  # fan scan clocks / busy-fraction wall
+        device_suspect_after: float = 0.0,  # s without device progress (0 = auto)
+        device_probe_interval: float = 30.0,  # s between re-admission probes
+        close_join_timeout: float = 5.0,  # s close() waits for launch threads
     ):
         # Injectable time for the fan's per-device scan clocks and the
         # busy-fraction wall anchor (resilience/clock.py): chaos/FakeClock
@@ -528,6 +578,45 @@ class JaxWorkBackend(WorkBackend):
         self.device_ema = [0.0] * n_fan
         self.fan_ema_alpha = 0.3  # same fold as fleet/registry.py
         self.last_win: Optional[dict] = None
+        # -- device fault domains (docs/resilience.md) --------------------
+        # Per-device healthy/suspect/quarantined state; the watchdog below
+        # observes progress from the control channel's per-(row, device)
+        # bookkeeping and evacuates a suspect device's uncovered range onto
+        # the healthy rest. Auto policy: the watchdog runs wherever the
+        # progress signal exists (run_mode=persistent, any width); chunked
+        # launches have no mid-launch bookkeeping, so their whole-launch
+        # deadline backstop only arms when the operator sets
+        # --device_suspect_after explicitly.
+        if device_suspect_after < 0:
+            raise WorkError("device_suspect_after must be >= 0 (0 = auto)")
+        self._watchdog_enabled = (
+            run_mode == "persistent" or device_suspect_after > 0
+        )
+        self.device_suspect_after = device_suspect_after or 30.0
+        self.device_probe_interval = device_probe_interval
+        self.close_join_timeout = close_join_timeout
+        self._dfd = DeviceFaultDomains(
+            n_fan or 1,
+            suspect_after=self.device_suspect_after,
+            probe_interval=device_probe_interval,
+            clock=self._clock,
+        )
+        self._watchdog_task: Optional[asyncio.Task] = None
+        self._probe_tasks: Dict[int, asyncio.Task] = {}
+        self._devices_exhausted = False
+        # EMA of wall seconds per launch window (from applied launches):
+        # the poll-cadence → seconds conversion the progress deadlines use.
+        self._window_seconds = 0.0
+        # EMA of dispatch → first-control-poll latency (XLA compile +
+        # dispatch): a launch that has not polled AT ALL yet gets this
+        # much extra deadline — a cold compile (30s+ through a remote
+        # tunnel) must not read as a dead device.
+        self._first_poll_seconds = 0.0
+        self._m_threads_leaked = obs.get_registry().counter(
+            "dpow_backend_launch_threads_leaked_total",
+            "Launch threads abandoned still running (watchdog ejection, "
+            "launch timeout, or wedged past the close() join bound), "
+            "detached and counted instead of awaited forever")
 
     # -- WorkBackend interface -------------------------------------------
 
@@ -569,6 +658,15 @@ class JaxWorkBackend(WorkBackend):
     async def generate(self, request: WorkRequest) -> str:
         if self._closed:
             raise WorkError("backend closed")
+        if self._devices_exhausted:
+            # The fault domains already declared every device quarantined:
+            # fail fast so the failover chain serves NOW (it trips this
+            # engine's breaker on sight) instead of queueing work behind
+            # re-admission probes.
+            raise DevicesExhausted(
+                f"all {self._dfd.n} device(s) quarantined; awaiting a "
+                "successful re-admission probe"
+            )
         key = request.block_hash
         existing = self._jobs.get(key)
         if existing is not None and not existing.cancelled and not existing.future.done():
@@ -701,7 +799,7 @@ class JaxWorkBackend(WorkBackend):
             if not covered:
                 span_dev = self.chunk_per_shard * rec.shape[1]
                 if self.fan is not None:
-                    bases = self._fan_launch_bases(job, span_dev)
+                    bases = self._rebase_bases_for(rec, job, span_dev)
                 else:
                     bases = [job.base]
                 if rec.control.rebase(row, bases, epoch=job.dev_epoch):
@@ -721,13 +819,21 @@ class JaxWorkBackend(WorkBackend):
         job = self._jobs.get(nc.validate_block_hash(block_hash))
         if job is None or job.cancelled or job.future.done():
             return False
+        self._re_cover(job, nonce_range[0], nonce_range[1])
+        return True
+
+    def _re_cover(self, job: _Job, start: int, length: int) -> None:
+        """Re-aim a running job at ``[start, start+length)`` — the shared
+        core of the fleet cover_range path and the watchdog's device
+        evacuation (both epoch-fenced the same way)."""
         if self.fan is not None:
-            # EVERY device shard rebases into the new range (the epoch bump
-            # inside _fan_partition keeps old-partition launches still on
-            # the wire from feeding the new shards' counters/clocks).
-            self._fan_partition(job, nonce_range[0], nonce_range[1])
+            # EVERY active device shard rebases into the new range (the
+            # epoch bump inside _fan_partition keeps old-partition launches
+            # still on the wire from feeding the new shards'
+            # counters/clocks).
+            self._fan_partition(job, start, length)
         else:
-            job.set_base(nonce_range[0])
+            job.set_base(start)
             # Same staleness fence as the fan: a launch already on the wire
             # was aimed at the OLD region — its weak hit (raised-target
             # race, _apply_plain_rows) must not rewind the frontier out of
@@ -743,7 +849,6 @@ class JaxWorkBackend(WorkBackend):
             # frontier — bounded overlap, never a gap.
             job.inflight_miss = self._miss_factor(job.difficulty, span)
         self._wakeup.set()
-        return True
 
     async def close(self) -> None:
         self._closed = True
@@ -756,6 +861,15 @@ class JaxWorkBackend(WorkBackend):
                 await warm_task
             except asyncio.CancelledError:
                 pass
+        watchdog_task, self._watchdog_task = self._watchdog_task, None
+        if watchdog_task is not None:
+            watchdog_task.cancel()
+            await asyncio.gather(watchdog_task, return_exceptions=True)
+        probe_tasks, self._probe_tasks = list(self._probe_tasks.values()), {}
+        for t in probe_tasks:
+            t.cancel()
+        if probe_tasks:
+            await asyncio.gather(*probe_tasks, return_exceptions=True)
         for job in list(self._jobs.values()):
             if not job.future.done():
                 job.future.set_exception(WorkCancelled("backend closed"))
@@ -776,15 +890,400 @@ class JaxWorkBackend(WorkBackend):
                 # The engine already failed its waiters before dying; its
                 # exception must not break teardown too.
                 pass
+        # Bounded join (Clock-driven): give the persistent launch threads
+        # one close_join_timeout to come back (their rows are cancelled, so
+        # a HEALTHY thread returns within a poll interval). A thread still
+        # out past the bound is truly wedged — kill-fence its control rows
+        # (a zombie wake-up then stops at its first poll and can steer
+        # nothing), DETACH it (the slot retires via the engine-teardown
+        # done-callback if it ever returns, and its executor threads are
+        # waived from the interpreter-exit join) and COUNT it, instead of
+        # blocking shutdown forever.
+        def _returned(rec) -> bool:
+            if rec.thread_done is not None:
+                return rec.thread_done.is_set()
+            return rec.fut.done()
+
+        joinable = [
+            rec for rec in list(self._inflight)
+            if rec.control is not None and not _returned(rec)
+        ]
+        if joinable:
+            step = max(self.close_join_timeout / 20.0, 0.005)
+            deadline = self._clock.time() + self.close_join_timeout
+            while (
+                any(not _returned(rec) for rec in joinable)
+                and self._clock.time() < deadline
+            ):
+                # Real-thread rendezvous: thread_done is set from executor
+                # threads in REAL time, so a frozen FakeClock must not
+                # stop close() from observing a healthy return — the
+                # real-time poll provides liveness while the BOUND itself
+                # rides the injectable clock (the wedged-thread tests
+                # advance it to trip the leak path).
+                timer = asyncio.ensure_future(self._clock.sleep(step))
+                # dpowlint: disable=DPOW101 — liveness poll for real executor threads; the deadline above is what rides the Clock
+                poll = asyncio.ensure_future(asyncio.sleep(0.01))
+                await asyncio.wait(
+                    {timer, poll}, return_when=asyncio.FIRST_COMPLETED
+                )
+                timer.cancel()
+                poll.cancel()
+            for rec in joinable:
+                if _returned(rec):
+                    continue
+                rec.control.kill_all()
+                self._m_threads_leaked.inc(1)
+                from ..utils.logging import get_logger
+
+                get_logger("tpu_dpow.backend").error(
+                    "launch thread (batch=%d, steps=%d) wedged past the "
+                    "%.1fs close bound; detached and counted",
+                    rec.shape[0], rec.shape[1], self.close_join_timeout,
+                )
+        self._inflight.clear()
         if self._executor is not None:
-            self._executor.shutdown(wait=False)
+            self._detach_executor(self._executor)
             self._executor = None
+
+    # -- device fault domains (docs/resilience.md) ------------------------
+
+    def _ensure_watchdog(self) -> None:
+        if not self._watchdog_enabled or self._closed:
+            return
+        if self._watchdog_task is None or self._watchdog_task.done():
+            self._watchdog_task = asyncio.ensure_future(self._watchdog_loop())
+
+    async def _watchdog_loop(self) -> None:
+        """Periodic health sweep on the injectable clock: declare devices
+        that missed their progress deadline suspect (→ evacuate →
+        quarantine) and launch re-admission probes when due."""
+        interval = max(self.device_suspect_after / 4.0, 0.01)
+        while not self._closed:
+            await self._clock.sleep(interval)
+            if self._closed:
+                return
+            try:
+                self._watchdog_pass()
+            except Exception:
+                from ..utils.logging import get_logger
+
+                # A watchdog bug must degrade to "no fault handling", not
+                # take the engine down with it.
+                get_logger("tpu_dpow.backend").warning(
+                    "device watchdog pass failed", exc_info=True)
+            self._spawn_due_probes()
+            if (
+                (self._engine_task is None or self._engine_task.done())
+                and not self._inflight
+                and len(self._dfd.healthy_devices()) == self._dfd.n
+            ):
+                return  # idle and fully healthy; _ensure_engine revives us
+
+    def _expected_poll_seconds(self) -> float:
+        """Expected wall seconds between a device's control polls, from
+        the window-time EMA of applied launches (0.0 until one applies —
+        the deadline then floors at device_suspect_after)."""
+        return self._window_seconds * max(1, self.control_poll_steps)
+
+    def _watchdog_pass(self) -> None:
+        """One sweep over the in-flight launches: progress is read from
+        the control channel's per-(row, device) poll/done bookkeeping —
+        a device is EXPECTED to poll every control_poll_steps windows
+        until all its rows are done or it clears its final poll block."""
+        now = self._clock.time()
+        suspects: list = []
+        hung_chunked: list = []
+        for rec in list(self._inflight):
+            if rec.fut.done() or rec.abandoned:
+                continue
+            if rec.control is not None:
+                deadline = launch_deadline(
+                    self._expected_poll_seconds(), self.device_suspect_after
+                )
+                if rec.control.first_poll_t is None:
+                    # Compile + dispatch still in front of the program's
+                    # first poll: grant a grace window (at least double,
+                    # plus the measured first-poll EMA scaled) so a cold
+                    # XLA compile does not read as a dead device.
+                    deadline += max(
+                        deadline, self._first_poll_seconds * DEADLINE_SLACK
+                    )
+                for s, d in enumerate(rec.fan_map or [0]):
+                    if self._dfd.state(d) != HEALTHY or d in suspects:
+                        continue
+                    if rec.control.device_accounted(
+                        s, rec.shape[1], self.control_poll_steps
+                    ):
+                        continue
+                    t, _k = rec.control.last_poll(s)
+                    last = t if t is not None else rec.t_clock
+                    if now - last > deadline:
+                        suspects.append(d)
+            else:
+                # Chunked launches have no mid-launch bookkeeping: the
+                # whole launch is the unit, its deadline run_steps-scaled.
+                # No per-device evidence → evacuate without quarantining.
+                deadline = launch_deadline(
+                    self._window_seconds * rec.shape[1],
+                    self.device_suspect_after,
+                )
+                if self._window_seconds <= 0.0:
+                    # No timing history yet: the first launch may be
+                    # paying an XLA compile — the chunked twin of the
+                    # persistent branch's no-first-poll grace.
+                    deadline *= 2.0
+                if now - rec.t_clock > deadline:
+                    hung_chunked.append(rec)
+        for d in suspects:
+            self._declare_suspect(d)
+        for rec in hung_chunked:
+            if rec in self._inflight:
+                self._evacuate_launch(rec, reason="launch_hang")
+
+    def _declare_suspect(self, d: int) -> None:
+        """healthy → suspect → (evacuate) → quarantined, exactly once.
+
+        Every launch pinned by the suspect device is ejected (a pmap
+        launch cannot return while one member hangs) with its control rows
+        kill-fenced, then each affected job's uncovered remainder — the
+        suspect device's effective base plus its provably-dry windows — is
+        re-covered onto the remaining healthy devices through the
+        epoch-fenced cover_range path. Subsequent launches run at degraded
+        fan width until a probe re-admits the device."""
+        if not self._dfd.mark_suspect(d):
+            return
+        wrecked = [
+            rec for rec in list(self._inflight)
+            if not rec.fut.done() and not rec.abandoned
+            and d in (rec.fan_map or [0])
+        ]
+        evacuations: Dict[int, tuple] = {}
+        for rec in wrecked:
+            for i, job in enumerate(rec.jobs):
+                if job.cancelled or job.future.done():
+                    continue
+                start, length = self._dead_remainder(rec, i, job, d)
+                prev = evacuations.get(id(job))
+                # Several wrecked launches: keep the least-advanced
+                # remainder (re-covering a superset is overlap, not a gap).
+                if prev is None or ((start - job.part_start) & _MASK64) < (
+                    (prev[1] - job.part_start) & _MASK64
+                ):
+                    evacuations[id(job)] = (job, start, length)
+            self._eject_launch(rec)
+        for job, start, length in evacuations.values():
+            self._re_cover(job, start, length)
+        if evacuations:
+            # The counter means "a range was re-covered": a suspect device
+            # whose launches carried only done/cancelled jobs evacuates
+            # nothing (same guard as _evacuate_launch).
+            self._dfd.record_evacuation("stalled_poll")
+        self._dfd.quarantine(d)
+        if self._dfd.exhausted():
+            self._fail_devices_exhausted()
+        self._wakeup.set()
+
+    def _dead_remainder(self, rec: "_Launch", i: int, job: _Job, d: int) -> tuple:
+        """The suspect device's uncovered remainder of row ``i``: its
+        effective base (a delivered mid-launch rebase counts) advanced by
+        the windows its own polls PROVED dry, out to the end of the job's
+        recorded partition range (length 0 = soft / full span)."""
+        fan_map = rec.fan_map or [0]
+        s = fan_map.index(d)
+        if rec.dev_bases is not None:
+            base = rec.dev_bases[i][s]
+        else:
+            base = rec.bases[i]
+        windows = 0
+        if rec.control is not None:
+            eb = rec.control.effective_base(i, s)
+            windows = rec.control.confirmed_no_hit_windows(
+                i, s, self.control_poll_steps
+            )
+            if eb is not None:
+                # A delivered rebase re-aimed the device at eb AT window
+                # applied_at_k: only the windows after that boundary were
+                # scanned from the new base — counting the pre-rebase ones
+                # would advance the evacuation frontier past nonces the
+                # device never visited (a gap, not an overlap; the apply
+                # path subtracts the same boundary for scan credit).
+                base = eb
+                windows = max(0, windows - rec.control.applied_at_k(i, s))
+        start = (base + windows * self.chunk_per_shard) & _MASK64
+        if job.part_len:
+            end = (job.part_start + job.part_len) & _MASK64
+            length = (end - start) & _MASK64
+            if length > job.part_len:
+                length = 0  # frontier already past the range end: soft
+            return start, length
+        return start, 0
+
+    def _eject_launch(self, rec: "_Launch") -> None:
+        """Pull a wrecked launch out of the pipeline: its results are
+        discarded (never applied), its control rows are kill-fenced so the
+        zombie thread stops at its first wake-up poll and cannot be
+        steered, and the executor is replaced so the wedged worker cannot
+        starve later launches (the launch-timeout idiom)."""
+        rec.abandoned = True
+        try:
+            self._inflight.remove(rec)
+        except ValueError:
+            pass
+        if rec.waiter is not None:
+            rec.waiter.cancel()
+        for job, f in zip(rec.jobs, rec.miss_factors):
+            if not job.future.done() and not job.cancelled:
+                # Its span will never be applied: undo the coverage factor.
+                job.inflight_miss = min(1.0, job.inflight_miss / f)
+        if rec.control is not None:
+            rec.control.kill_all()
+            _retire_on_done(rec.fut, rec.slot)
+        else:
+            rec.fut.add_done_callback(_consume_abandoned)
+        if rec.thread_done is not None and not rec.thread_done.is_set():
+            # The ejection abandons a thread that is still out — count it
+            # (most drain when the zombie device wakes; the counter
+            # measures abandonment events, matching the close() bound).
+            self._m_threads_leaked.inc(1)
+        if self._executor is not None:
+            self._detach_executor(self._executor)
+            self._executor = None
+        self._wakeup.set()
+
+    def _evacuate_launch(self, rec: "_Launch", reason: str) -> None:
+        """Whole-launch evacuation (chunked backstop): eject the launch
+        and re-cover each live job from the launch's own dispatch frontier
+        (fan: the whole recorded partition range — chunked launches carry
+        no per-device progress evidence to narrow it)."""
+        jobs = [
+            (i, j) for i, j in enumerate(rec.jobs)
+            if not j.cancelled and not j.future.done()
+        ]
+        self._eject_launch(rec)
+        for i, job in jobs:
+            if self.fan is not None:
+                self._re_cover(job, job.part_start, job.part_len)
+            else:
+                self._re_cover(job, rec.bases[i], 0)
+        if jobs:
+            self._dfd.record_evacuation(reason)
+
+    def _fail_devices_exhausted(self) -> None:
+        """Zero healthy devices: the engine declares ITSELF dead — every
+        live waiter fails NOW with DevicesExhausted (the failover chain
+        trips this engine's breaker on sight instead of waiting out its
+        hang budget) and new generates refuse until a probe re-admits a
+        device."""
+        self._devices_exhausted = True
+        err_msg = (
+            f"all {self._dfd.n} device(s) quarantined; awaiting a "
+            "successful re-admission probe"
+        )
+        for job in list(self._jobs.values()):
+            if not job.future.done():
+                job.cancelled = True
+                self._control_cancel_job(job)
+                job.future.set_exception(DevicesExhausted(err_msg))
+        self._wakeup.set()
+
+    def _spawn_due_probes(self) -> None:
+        for d in range(self._dfd.n):
+            if not self._dfd.probe_due(d):
+                continue
+            task = self._probe_tasks.get(d)
+            if task is not None and not task.done():
+                continue
+            self._probe_tasks[d] = asyncio.ensure_future(self._probe_device(d))
+
+    async def _probe_device(self, d: int) -> None:
+        """The single re-admission launch for quarantined device ``d``: a
+        difficulty-1 probe row must come back (hitting at offset 0, the
+        setup self-test contract) within the probe bound on the injectable
+        clock. Success re-admits the device and re-balances live jobs over
+        the restored fan; failure re-opens the probe interval."""
+        probe = search.pack_params(bytes(32), 1, base=0)
+        devs = (self.fan[d],) if self.fan is not None else None
+        ok = False
+        fut = None
+        try:
+            fut = self._submit_launch(np.stack([probe]), 1, devices=devs)
+            timer = asyncio.ensure_future(
+                self._clock.sleep(self.device_suspect_after)
+            )
+            await asyncio.wait(
+                {fut, timer}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if fut.done():
+                timer.cancel()
+                lo, hi = fut.result()
+                ok = int(lo.flat[0]) == 0 and int(hi.flat[0]) == 0
+            else:
+                # The probe itself hung: abandon its thread (counted) and
+                # hand later launches a fresh executor.
+                fut.add_done_callback(_consume_abandoned)
+                self._m_threads_leaked.inc(1)
+                if self._executor is not None:
+                    self._detach_executor(self._executor)
+                    self._executor = None
+        except asyncio.CancelledError:
+            if fut is not None and not fut.done():
+                fut.add_done_callback(_consume_abandoned)
+            raise
+        except Exception:
+            ok = False  # a crashing probe is a failed probe
+        prev_active = self._fan_active if self.fan is not None else None
+        self._dfd.probe_result(d, ok)
+        if not ok:
+            return
+        self._devices_exhausted = False
+        if self.fan is not None:
+            # Re-balance live jobs over the restored fan: re-partition each
+            # from its least-advanced healthy frontier — overlap over gaps
+            # (soft ranges), and the epoch bump fences degraded launches
+            # still on the wire.
+            for job in list(self._jobs.values()):
+                if job.cancelled or job.future.done():
+                    continue
+                if job.dev_bases is not None and prev_active:
+                    # Least-advanced frontier RELATIVE to the partition
+                    # start (wrap-aware): a range wrapping 2^64 makes the
+                    # numerically smallest base the MOST advanced shard.
+                    start = min(
+                        (job.dev_bases[dd] for dd in prev_active),
+                        key=lambda bs: (bs - job.part_start) & _MASK64,
+                    )
+                else:
+                    start = job.base
+                length = 0
+                if job.part_len:
+                    length = (job.part_start + job.part_len - start) & _MASK64
+                    if length > job.part_len:
+                        length = 0
+                self._re_cover(job, start, length)
+        self._wakeup.set()
+
+    @staticmethod
+    def _detach_executor(executor) -> None:
+        """shutdown(wait=False) AND waive the pool's threads from the
+        interpreter-exit join: concurrent.futures registers every worker
+        in a module-global table that Python joins at shutdown, so one
+        wedged launch thread would otherwise hang process exit forever —
+        the exact failure the close bound exists for. Running healthy
+        threads still complete and resolve their futures; only the
+        exit-join is waived (private API, stable since 3.9)."""
+        import concurrent.futures.thread as cft
+
+        executor.shutdown(wait=False)
+        for t in list(getattr(executor, "_threads", ()) or ()):
+            cft._threads_queues.pop(t, None)
 
     # -- engine -----------------------------------------------------------
 
     def _ensure_engine(self) -> None:
         if self._engine_task is None or self._engine_task.done():
             self._engine_task = asyncio.ensure_future(self._engine_loop())
+        self._ensure_watchdog()
 
     def _batch_sizes(self) -> list:
         """The padded batch sizes the engine may emit (ascending).
@@ -929,10 +1428,14 @@ class JaxWorkBackend(WorkBackend):
         steps: int,
         timing: Optional[dict] = None,
         slot: int = 0,
+        devices: Optional[tuple] = None,
+        thread_done: Optional[threading.Event] = None,
     ) -> asyncio.Future:
         """Hand a launch to the executor; device work starts immediately.
         ``slot`` routes a persistent launch's control polls (0 = no control
-        block registered: the launch reads dead zeros and just runs)."""
+        block registered: the launch reads dead zeros and just runs).
+        ``devices`` pins the launch to a fan subset (degraded width /
+        re-admission probes); None = the engine's full complement."""
         if self._executor is None:
             import concurrent.futures
 
@@ -943,11 +1446,32 @@ class JaxWorkBackend(WorkBackend):
         loop = asyncio.get_running_loop()
 
         def call_launch():
-            # Chunked launches (slot 0) keep the two-arg call: _launch
-            # wrappers installed by tests and tooling predate the slot.
-            if slot:
-                return self._launch(params_batch, steps, slot)
-            return self._launch(params_batch, steps)
+            # Chunked full-width launches (slot 0) keep the two-arg call:
+            # _launch wrappers installed by tests and tooling predate the
+            # slot and the device-subset kwarg.
+            try:
+                if devices is not None:
+                    return self._launch(
+                        params_batch, steps, slot, devices=devices
+                    )
+                if slot:
+                    return self._launch(params_batch, steps, slot)
+                return self._launch(params_batch, steps)
+            finally:
+                # The control slot lives exactly as long as the thread:
+                # releasing any earlier feeds a still-running loop dead
+                # zeros and UNDOES its cancel/kill flags (the rows then
+                # grind the whole span while pinning an execution thread
+                # — observed starving the evacuation's recovery launch
+                # when an ejected launch's cancelled future released the
+                # slot early). release() is idempotent; the apply path's
+                # and teardown's releases remain as belt-and-suspenders.
+                if slot:
+                    ctl.release(slot)
+                # The thread-return flag the close()/watchdog bounds watch
+                # — the asyncio future lies once its waiter is cancelled.
+                if thread_done is not None:
+                    thread_done.set()
 
         if timing is None:
             return loop.run_in_executor(self._executor, call_launch)
@@ -975,9 +1499,12 @@ class JaxWorkBackend(WorkBackend):
             # The wedged thread cannot be killed; abandon the whole executor
             # so later launches get fresh workers instead of queueing behind
             # the stuck one. (Other in-flight launches on it are presumed
-            # wedged on the same tunnel and abandoned with it.)
-            self._executor.shutdown(wait=False)
+            # wedged on the same tunnel and abandoned with it.) Detached
+            # from the interpreter-exit join and counted, like every other
+            # abandoned-thread site.
+            self._detach_executor(self._executor)
             self._executor = None
+            self._m_threads_leaked.inc(1)
             raise WorkError(
                 f"device launch exceeded {self.launch_timeout:.0f}s "
                 f"({shape_note}) — tunnel or device hang"
@@ -990,7 +1517,13 @@ class JaxWorkBackend(WorkBackend):
             f"batch={params_batch.shape[0]}, steps={steps}",
         )
 
-    def _launch(self, params_batch: np.ndarray, steps: int, slot: int = 0) -> tuple:
+    def _launch(
+        self,
+        params_batch: np.ndarray,
+        steps: int,
+        slot: int = 0,
+        devices: Optional[tuple] = None,
+    ) -> tuple:
         """One blocking batched device launch (called via to_thread).
 
         Returns (lo, hi) uint32[B] — absolute winning nonces per row,
@@ -1003,14 +1536,18 @@ class JaxWorkBackend(WorkBackend):
         window hits. In persistent mode the same span runs as a
         device-resident while_loop polling control slot ``slot`` between
         windows (one compile per shape; the slot id is a traced value).
+        ``devices`` pins a fan launch to a subset of the fan (degraded
+        width after quarantine, single-device re-admission probes).
         """
+        ctl.launch_hook(self._launch_hook_indices(devices))
         if self.run_mode == "persistent":
-            return self._launch_persistent(params_batch, steps, slot)
+            return self._launch_persistent(params_batch, steps, slot, devices)
         nblocks = self.nblocks * steps
         if self.fan is not None:
             from ..parallel import fan_search_devices
 
-            n = len(self.fan)
+            devs = tuple(devices) if devices is not None else tuple(self.fan)
+            n = len(devs)
             span_dev = self.chunk_per_shard * steps
             if params_batch.ndim == 2:
                 # Bare rows (setup self-test, warm probes): interleave from
@@ -1018,7 +1555,7 @@ class JaxWorkBackend(WorkBackend):
                 params_batch = self._fan_stack_probe(params_batch, n, span_dev)
             offs = fan_search_devices(
                 params_batch,
-                devices=self.fan,
+                devices=devs,
                 chunk_per_shard=span_dev,
                 kernel=self.kernel,
                 sublanes=self.sublanes,
@@ -1064,8 +1601,21 @@ class JaxWorkBackend(WorkBackend):
             out = search.search_chunk_batch(pj, chunk_size=self.chunk * steps)
         return self._offsets_to_nonces(params_batch, np.asarray(out))
 
+    def _launch_hook_indices(self, devices: Optional[tuple]) -> tuple:
+        """PHYSICAL fan indices this launch touches — the chaos seam's
+        device identities (ops/control.py launch_hook)."""
+        if self.fan is None:
+            return (0,)
+        if devices is None:
+            return tuple(range(len(self.fan)))
+        return tuple(self.fan.index(d) for d in devices)
+
     def _launch_persistent(
-        self, params_batch: np.ndarray, steps: int, slot: int
+        self,
+        params_batch: np.ndarray,
+        steps: int,
+        slot: int,
+        devices: Optional[tuple] = None,
     ) -> tuple:
         """One blocking PERSISTENT launch: a device-resident while_loop of
         ``steps`` windows (ops/runloop.py) that polls control slot ``slot``
@@ -1080,7 +1630,8 @@ class JaxWorkBackend(WorkBackend):
         if self.fan is not None:
             from ..parallel import fan_search_run_controlled
 
-            n = len(self.fan)
+            devs = tuple(devices) if devices is not None else tuple(self.fan)
+            n = len(devs)
             if params_batch.ndim == 2:
                 # Bare rows (setup self-test, warm probes): block-interleave
                 # from each row's own base, as the controlled fan scans
@@ -1091,7 +1642,7 @@ class JaxWorkBackend(WorkBackend):
             lo, hi = fan_search_run_controlled(
                 params_batch,
                 slot,
-                devices=self.fan,
+                devices=devs,
                 chunk_per_shard=self.chunk_per_shard,
                 max_steps=steps,
                 poll_steps=self.control_poll_steps,
@@ -1152,9 +1703,18 @@ class JaxWorkBackend(WorkBackend):
 
     # -- device fan (devices >= 1) ----------------------------------------
 
+    @property
+    def _fan_active(self) -> list:
+        """PHYSICAL indices of the devices currently in the fan — the
+        healthy set of the fault domains (docs/resilience.md). Quarantined
+        devices are excluded from partitions and launches until a probe
+        re-admits them; the single source of truth is the state machine."""
+        return self._dfd.healthy_devices()
+
     def _fan_partition(self, job: _Job, start: int, length: int) -> None:
         """Sub-partition ``[start, start+length)`` (length 0 = full 2^64
-        span) across the fan — the fleet partition idiom one level down.
+        span) across the HEALTHY fan — the fleet partition idiom one level
+        down, at whatever width the fault domains currently allow.
 
         'split' gives each device a contiguous macro-range (its own shard:
         per-device frontier, scan counter and scan clock — EMA attribution
@@ -1166,32 +1726,55 @@ class JaxWorkBackend(WorkBackend):
         into its neighbor's sub-range rather than strand a dispatch whose
         shard holds no solution.
         """
-        n = len(self.fan)
+        n_total = len(self.fan)
+        active = self._fan_active
+        n = max(len(active), 1)
         job.set_base(start)
+        job.part_start, job.part_len = start & _MASK64, length
         if self.device_shard == "split":
             stride = max((length or (1 << 64)) // n, 1)
-            job.dev_bases = [(start + d * stride) & _MASK64 for d in range(n)]
+            # Full-length table (stale entries for quarantined devices are
+            # never packed); strides go to the healthy set in order.
+            if job.dev_bases is None or len(job.dev_bases) != n_total:
+                job.dev_bases = [start & _MASK64] * n_total
+            for i, d in enumerate(active):
+                job.dev_bases[d] = (start + i * stride) & _MASK64
         else:
             job.dev_bases = None  # derived from the frontier at pack time
-        job.dev_scanned = [0] * n
+        job.dev_scanned = [0] * n_total
         job.dev_t0 = None  # stamped at the first dispatch of this partition
         job.dev_epoch += 1
 
     def _fan_launch_bases(self, job: _Job, span_dev: int) -> list:
-        """This launch's per-device bases for one job (pre-advance)."""
+        """This launch's per-slice bases for one job (pre-advance),
+        parallel to the current healthy set ``self._fan_active``."""
+        active = self._fan_active
         if job.dev_bases is not None:  # split: each device's own frontier
-            return list(job.dev_bases)
+            return [job.dev_bases[d] for d in active]
         # interleave: consecutive windows of the single frontier
-        return [(job.base + d * span_dev) & _MASK64 for d in range(len(self.fan))]
+        return [
+            (job.base + i * span_dev) & _MASK64 for i in range(len(active))
+        ]
+
+    def _rebase_bases_for(self, rec: "_Launch", job: _Job, span_dev: int) -> list:
+        """Per-slice rebase bases for a RUNNING launch — keyed by the
+        launch's own fan_map, which may differ from the current healthy
+        set (a pre-quarantine launch still live on the wire)."""
+        fan_map = rec.fan_map or list(range(len(self.fan)))
+        if job.dev_bases is not None:
+            return [job.dev_bases[d] for d in fan_map]
+        return [
+            (job.base + s * span_dev) & _MASK64 for s in range(len(fan_map))
+        ]
 
     def _fan_advance(self, job: _Job, span_dev: int) -> None:
-        """Speculative frontier advance at dispatch (all device shards)."""
+        """Speculative frontier advance at dispatch (active device shards)."""
+        active = self._fan_active
         if job.dev_bases is not None:
-            job.dev_bases = [
-                (b + span_dev) & _MASK64 for b in job.dev_bases
-            ]
+            for d in active:
+                job.dev_bases[d] = (job.dev_bases[d] + span_dev) & _MASK64
         else:
-            job.set_base(job.base + span_dev * len(self.fan))
+            job.set_base(job.base + span_dev * max(len(active), 1))
 
     def _fan_stack(self, jobs: list, b: int, steps: int) -> tuple:
         """Fan batch: uint32[n_dev, b, 12] plus the per-job base snapshot.
@@ -1199,9 +1782,10 @@ class JaxWorkBackend(WorkBackend):
         Row content matches _pack (active jobs + difficulty-0 padding);
         each device's slice carries that device's base words. Padding rows
         hit at offset 0 on every device and early-exit, exactly as on the
-        single-device path.
+        single-device path. Width is the HEALTHY fan: quarantined devices
+        get no slice (the launch runs at degraded width on the rest).
         """
-        n = len(self.fan)
+        n = len(self._fan_active)
         span_dev = self.chunk_per_shard * steps
         rows = self._pack(jobs, b)
         stacked = np.repeat(rows[None], n, axis=0)
@@ -1291,6 +1875,10 @@ class JaxWorkBackend(WorkBackend):
         the NEXT span instead of re-scanning this one.
         """
         self._gc_jobs()
+        if self._devices_exhausted or (
+            self.fan is not None and not self._fan_active
+        ):
+            return None  # zero healthy devices: nothing can be dispatched
         alive = [j for j in self._jobs.values() if not j.cancelled]
         if not alive:
             return None
@@ -1352,12 +1940,20 @@ class JaxWorkBackend(WorkBackend):
             active = pool[: self.max_batch]
         b, steps = self._pick_shape(len(active), steps_want)
         active = active[:b]
-        dev_snap = None
+        dev_snap, fan_map, launch_devs = None, None, None
         if self.fan is not None:
+            # Snapshot the healthy set: the launch runs on exactly these
+            # devices, and every apply/attribution path maps its slices
+            # through this list — the watchdog may shrink the fan while
+            # this launch is still on the wire.
+            fan_map = list(self._fan_active)
             params, dev_snap = self._fan_stack(active, b, steps)
+            if fan_map != list(range(len(self.fan))):
+                launch_devs = tuple(self.fan[d] for d in fan_map)
+            span = self.chunk_per_shard * steps * len(fan_map)
         else:
             params = self._pack(active, b)
-        span = self.chunk * steps  # global: every device's sub-span summed
+            span = self.chunk * steps  # global: every sub-span summed
         factors = [self._miss_factor(j.difficulty, span) for j in active]
         # Timing stamps the PHYSICAL queue depth: the overhead
         # decomposition buckets head-vs-successor device time by
@@ -1387,11 +1983,18 @@ class JaxWorkBackend(WorkBackend):
             # launch's results are applied (a late straggler poll then reads
             # dead zeros — the same fence as a killed row).
             launch_control = ctl.LaunchControl(
-                b, clock=self._clock, n_dev=len(self.fan) if self.fan else 1
+                b,
+                clock=self._clock,
+                n_dev=len(fan_map) if fan_map else 1,
+                fan_map=fan_map,
             )
             slot = ctl.register(launch_control)
+        thread_done = threading.Event()
         rec = _Launch(
-            fut=self._submit_launch(params, steps, timing, slot),
+            fut=self._submit_launch(
+                params, steps, timing, slot, devices=launch_devs,
+                thread_done=thread_done,
+            ),
             jobs=active,
             # Snapshot targets and bases at launch: a concurrent dedup may
             # raise job.difficulty, and a pipelined successor dispatch will
@@ -1409,6 +2012,9 @@ class JaxWorkBackend(WorkBackend):
             dev_epochs=[j.dev_epoch for j in active],
             control=launch_control,
             slot=slot,
+            fan_map=fan_map,
+            t_clock=self._clock.time(),
+            thread_done=thread_done,
         )
         span_dev = self.chunk_per_shard * steps
         for job, f in zip(active, factors):
@@ -1434,6 +2040,7 @@ class JaxWorkBackend(WorkBackend):
                 )
             if self.record_timeline:
                 self.timeline.append(("launch", timing))
+        windows_ran = rec.shape[1]
         if rec.control is not None:
             # The launch is off the device: retire its control slot (a
             # straggler poll now reads dead zeros) and export what the
@@ -1441,13 +2048,30 @@ class JaxWorkBackend(WorkBackend):
             # their issue→delivery latency on the injectable clock.
             ctl.release(rec.slot)
             c = rec.control
+            windows_ran = min(c.last_k + self.control_poll_steps, rec.shape[1])
             self._m_p_polls.inc(c.polls)
-            self._m_p_windows.observe(
-                min(c.last_k + self.control_poll_steps, rec.shape[1])
-            )
+            self._m_p_windows.observe(windows_ran)
             for _row, action, latency, _token in c.delivered:
                 self._m_p_control.inc(1, action)
                 self._m_p_effect.observe(latency)
+        if timing is not None and "t_done" in timing and "t_thread" in timing:
+            # Wall seconds per launch window (EMA): the poll-cadence →
+            # seconds conversion behind the watchdog's progress deadlines.
+            dev_s = timing["t_done"] - timing["t_thread"]
+            if dev_s > 0.0 and windows_ran > 0:
+                w = dev_s / windows_ran
+                self._window_seconds = (
+                    w if self._window_seconds <= 0.0
+                    else 0.3 * w + 0.7 * self._window_seconds
+                )
+        if rec.control is not None and rec.control.first_poll_t is not None:
+            # Dispatch → first-poll latency (compile + dispatch) on the
+            # engine clock: the never-polled-yet grace window's scale.
+            fp = max(0.0, rec.control.first_poll_t - rec.t_clock)
+            self._first_poll_seconds = (
+                fp if self._first_poll_seconds <= 0.0
+                else 0.3 * fp + 0.7 * self._first_poll_seconds
+            )
         for job, f in zip(rec.jobs, rec.miss_factors):
             # This launch is no longer in flight: undo its coverage factor
             # (clamped — repeated multiply/divide may drift past 1.0).
@@ -1562,10 +2186,11 @@ class JaxWorkBackend(WorkBackend):
         the EMA sample exactly the way the fleet registry attributes a
         sharded win to the worker whose range contains the nonce.
         """
-        n = len(self.fan)
+        fan_map = rec.fan_map or list(range(len(self.fan)))
+        n = len(fan_map)  # launch slices; fan_map[s] is the physical device
         span_dev = rec.span // n
         applied_hashes = 0
-        per_dev_scanned = [0] * n
+        per_slice_scanned = [0] * n
         for i, (job, launched, bases, epoch) in enumerate(zip(
             rec.jobs, rec.launched_difficulty, rec.dev_bases, rec.dev_epochs
         )):
@@ -1581,35 +2206,36 @@ class JaxWorkBackend(WorkBackend):
             dry_scan = [span_dev] * n
             if rec.control is not None:
                 bases = list(bases)
-                for d in range(n):
-                    eb = rec.control.effective_base(i, d)
+                for s in range(n):
+                    eb = rec.control.effective_base(i, s)
                     if eb is not None:
-                        bases[d] = eb
-                    ed = rec.control.effective_difficulty(i, d)
+                        bases[s] = eb
+                    ed = rec.control.effective_difficulty(i, s)
                     if ed is not None:
-                        launched_dev[d] = ed
-                    epoch_dev[d] = rec.control.effective_epoch(i, epoch, d)
-                    dry_scan[d] = min(
+                        launched_dev[s] = ed
+                    epoch_dev[s] = rec.control.effective_epoch(i, epoch, s)
+                    dry_scan[s] = min(
                         span_dev,
-                        rec.control.windows_run(i, rec.shape[1], d)
+                        rec.control.windows_run(i, rec.shape[1], s)
                         * self.chunk_per_shard,
                     )
-            # Per-device results for this row: (local offset, device, nonce).
+            # Per-slice results for this row: (local offset, slice, nonce).
             cands = []
             row_scanned = list(dry_scan)
-            for d in range(n):
-                nonce = (int(hi_arr[d, i]) << 32) | int(lo_arr[d, i])
+            for s in range(n):
+                nonce = (int(hi_arr[s, i]) << 32) | int(lo_arr[s, i])
                 if nonce == _MASK64:
                     continue  # this device's sub-span was dry
-                local = (nonce - bases[d]) & _MASK64
-                row_scanned[d] = local + 1
-                cands.append((local, d, nonce))
-            hit_devs = {d for _l, d, _n in cands}
-            for d in range(n):
-                per_dev_scanned[d] += row_scanned[d]
-                applied_hashes += row_scanned[d]
-                self.total_hashes += row_scanned[d]
-                if job.dev_scanned is not None and epoch_dev[d] == job.dev_epoch:
+                local = (nonce - bases[s]) & _MASK64
+                row_scanned[s] = local + 1
+                cands.append((local, s, nonce))
+            hit_slices = {s for _l, s, _n in cands}
+            for s in range(n):
+                d = fan_map[s]
+                per_slice_scanned[s] += row_scanned[s]
+                applied_hashes += row_scanned[s]
+                self.total_hashes += row_scanned[s]
+                if job.dev_scanned is not None and epoch_dev[s] == job.dev_epoch:
                     # Same-partition results only: a cover_range rebase
                     # while this launch was on the wire reset the shard
                     # counters, and the old span must not inflate them.
@@ -1617,26 +2243,27 @@ class JaxWorkBackend(WorkBackend):
                     # then ran dry, subtract the windows it scanned in the
                     # OLD partition before applying (a hit's row_scanned
                     # is already relative to the rebased base).
-                    credit = row_scanned[d]
-                    if rec.control is not None and d not in hit_devs:
+                    credit = row_scanned[s]
+                    if rec.control is not None and s not in hit_slices:
                         credit = max(
                             0,
                             credit
-                            - rec.control.applied_at_k(i, d)
+                            - rec.control.applied_at_k(i, s)
                             * self.chunk_per_shard,
                         )
                     job.dev_scanned[d] += credit
             if job.future.done() or not cands:
                 continue
-            cands.sort()  # fewest-nonces-scanned first, device as tiebreak
-            for local, d, nonce in cands:
+            cands.sort()  # fewest-nonces-scanned first, slice as tiebreak
+            for local, s, nonce in cands:
+                d = fan_map[s]
                 work = search.work_hex_from_nonce(nonce)
                 value = nc.work_value(job.block_hash, work)
                 if value >= job.difficulty:
                     self._record_solve(job, work)
-                    self._attribute_win(job, d, epoch_dev[d])
+                    self._attribute_win(job, d, epoch_dev[s])
                     break
-                elif value >= launched_dev[d]:
+                elif value >= launched_dev[s]:
                     # Valid at the target device d was actually holding the
                     # row to, but raised past it meanwhile: ONLY the device
                     # that produced the weak hit resumes past it — its
@@ -1645,7 +2272,7 @@ class JaxWorkBackend(WorkBackend):
                     # launch was on the wire (epoch mismatch): rewinding
                     # would drag the frontier back into the OLD region and
                     # undo a cover_range re-cover.
-                    if epoch_dev[d] == job.dev_epoch:
+                    if epoch_dev[s] == job.dev_epoch:
                         if job.dev_bases is not None:
                             job.dev_bases[d] = (nonce + 1) & _MASK64
                         else:
@@ -1655,11 +2282,11 @@ class JaxWorkBackend(WorkBackend):
                         WorkError(
                             f"device produced invalid work {work} for "
                             f"{job.block_hash} "
-                            f"(value {value:016x} < {launched_dev[d]:016x})"
+                            f"(value {value:016x} < {launched_dev[s]:016x})"
                         )
                     )
                     break
-        self._fan_update_device_metrics(rec, per_dev_scanned)
+        self._fan_update_device_metrics(rec, per_slice_scanned)
         return applied_hashes
 
     def _attribute_win(self, job: _Job, d: int, epoch: int) -> None:
@@ -1692,8 +2319,9 @@ class JaxWorkBackend(WorkBackend):
         }
 
     def _fan_update_device_metrics(
-        self, rec: "_Launch", per_dev_scanned: list
+        self, rec: "_Launch", per_slice_scanned: list
     ) -> None:
+        fan_map = rec.fan_map or list(range(len(self.fan)))
         timing = rec.timing or {}
         # Physical device time (perf_counter) feeds the H/s rate — a
         # hardware measure; busy-vs-wall rides the INJECTABLE clock on
@@ -1707,7 +2335,7 @@ class JaxWorkBackend(WorkBackend):
             timing.get("t_done_clock", 0.0) - timing.get("t_thread_clock", 0.0),
         )
         wall = self._clock.time() - self._fan_wall_t0
-        for d, scanned in enumerate(per_dev_scanned):
+        for d, scanned in zip(fan_map, per_slice_scanned):
             label = str(d)
             self._m_dev_launches.inc(1, label)
             self._m_dev_hashes.inc(scanned, label)
@@ -1745,13 +2373,7 @@ class JaxWorkBackend(WorkBackend):
                     # is idempotent, so the happy path's release is safe).
                     for i in range(len(r.jobs)):
                         r.control.cancel(i)
-
-                    def _retire(f, s=r.slot):
-                        ctl.release(s)
-                        if not f.cancelled():
-                            f.exception()  # consume an abandoned failure
-
-                    r.fut.add_done_callback(_retire)
+                    _retire_on_done(r.fut, r.slot)
 
     async def _engine_loop_body(self, inflight: deque) -> None:
         while not self._closed:
@@ -1825,6 +2447,11 @@ class JaxWorkBackend(WorkBackend):
                 )
             finally:
                 wake.cancel()
+            if rec.abandoned:
+                # The watchdog ejected the head launch mid-wait (suspect
+                # device): it is already out of the deque, its rows are
+                # kill-fenced and its results must never be applied.
+                continue
             if not rec.waiter.done():
                 continue  # new demand: refill free slots, then keep waiting
             lo_arr, hi_arr = rec.waiter.result()

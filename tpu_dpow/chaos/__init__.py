@@ -8,7 +8,12 @@ all driven by one scripted :class:`FaultSchedule`:
                     per direction, per topic pattern;
   FaultyStore     — connection errors, delays, hangs, per key pattern;
   FaultyBackend   — WorkError, hang-until-cancel, wrong nonces, delays,
-                    per block hash.
+                    per block hash;
+  FaultyDevice    — hang-at-poll / slow-poll / dead-after-K-windows per
+                    DEVICE index, hooked at the jax engine's launch-thread
+                    and control-poll boundaries (ops/control.py) — the
+                    seam under the per-device fault domains
+                    (docs/resilience.md).
 
 Everything is deterministic: counts are exact, probabilistic rules draw
 from the schedule's seeded RNG, and every delay runs on an injectable
@@ -22,6 +27,7 @@ import asyncio as _asyncio
 
 from ..resilience.clock import FakeClock, SystemClock  # noqa: F401
 from .backend import FaultyBackend, invalid_work_for  # noqa: F401
+from .device import FaultyDevice  # noqa: F401
 from .schedule import (  # noqa: F401
     ACTIONS,
     DELAY,

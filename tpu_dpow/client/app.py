@@ -118,6 +118,9 @@ class DpowClient:
             kwargs["run_mode"] = config.run_mode
             if config.control_poll_steps > 0:
                 kwargs["control_poll_steps"] = config.control_poll_steps
+            if config.device_suspect_after > 0:
+                kwargs["device_suspect_after"] = config.device_suspect_after
+            kwargs["device_probe_interval"] = config.device_probe_interval
             if config.pipeline > 0:
                 kwargs["pipeline"] = config.pipeline
             kwargs["step_ladder"] = config.step_ladder

@@ -49,6 +49,17 @@ class ClientConfig:
     # 1 elsewhere). One poll interval is the worst-case mid-launch
     # cancel/raise/rebase latency; each poll is a host touch.
     control_poll_steps: int = 0
+    # Device fault domains (backend=jax, docs/resilience.md): seconds a
+    # device may go without control-channel progress before it is declared
+    # suspect, its range evacuated onto the healthy devices and the device
+    # quarantined. 0 = auto (30 s; the deadline also scales with the
+    # measured poll cadence). The watchdog arms automatically in
+    # run_mode=persistent; setting this explicitly also arms the chunked
+    # whole-launch backstop.
+    device_suspect_after: float = 0.0
+    # Seconds a quarantined device waits between single-launch
+    # re-admission probes (the per-device breaker's reset timeout).
+    device_probe_interval: float = 30.0
     pipeline: int = 0  # 0 = auto (2); launches in flight at once (backend=jax)
     step_ladder: str = "x4"  # run-length quantization ladder: x4 | x2 (backend=jax)
     shared_steps_cap: int = 0  # 0 = auto (run_steps/4); windows/launch under contention
@@ -101,6 +112,10 @@ class ClientConfig:
             raise ValueError("--run_mode must be 'chunked' or 'persistent'")
         if self.control_poll_steps < 0:
             raise ValueError("--control_poll_steps must be >= 0 (0 = auto)")
+        if self.device_suspect_after < 0:
+            raise ValueError("--device_suspect_after must be >= 0 (0 = auto)")
+        if self.device_probe_interval <= 0:
+            raise ValueError("--device_probe_interval must be > 0")
         if self.pipeline < 0:
             raise ValueError("--pipeline must be >= 0 (0 = auto)")
         if self.shared_steps_cap < 0:
@@ -194,6 +209,18 @@ def parse_args(argv=None) -> ClientConfig:
                    "polls (0 = auto: 8 on TPU, 1 elsewhere; one interval is "
                    "the worst-case mid-launch cancel latency, each poll is "
                    "a host touch)")
+    p.add_argument("--device_suspect_after", type=float,
+                   default=c.device_suspect_after,
+                   help="seconds a device may go without control-channel "
+                   "progress before the engine watchdog declares it "
+                   "suspect, evacuates its nonce range onto the healthy "
+                   "devices and quarantines it (backend=jax; 0 = auto: "
+                   "30s, scaled by the measured poll cadence)")
+    p.add_argument("--device_probe_interval", type=float,
+                   default=c.device_probe_interval,
+                   help="seconds a quarantined device waits between "
+                   "single-launch re-admission probes; a successful probe "
+                   "returns it to the fan (backend=jax)")
     p.add_argument("--pipeline", type=int, default=c.pipeline,
                    help="device launches in flight at once (backend=jax; "
                    "0 = auto: 2 — overlaps readback of one launch with "

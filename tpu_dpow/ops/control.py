@@ -68,6 +68,37 @@ _slots: Dict[int, "LaunchControl"] = {}
 _slot_ids = itertools.count(1)
 _slots_lock = threading.Lock()
 
+#: Chaos seams (tpu_dpow/chaos/device.py): optional hooks invoked on the
+#: DEVICE side of the channel — ``poll_hook(slot, device, k)`` before a
+#: control poll is served, ``launch_hook(devices)`` at the top of every
+#: engine launch (in the launch executor thread). A hook may BLOCK, which
+#: is exactly the fault being injected: a device that stops polling or a
+#: launch thread that wedges. Both run outside every lock in this module,
+#: so a hanging hook can never deadlock the host-side writers.
+_poll_hook = None
+_launch_hook = None
+
+
+def set_poll_hook(hook) -> None:
+    """Install (or clear, with None) the control-poll chaos hook."""
+    global _poll_hook
+    _poll_hook = hook
+
+
+def set_launch_hook(hook) -> None:
+    """Install (or clear, with None) the launch-boundary chaos hook."""
+    global _launch_hook
+    _launch_hook = hook
+
+
+def launch_hook(devices) -> None:
+    """Called by the engine at the top of every device launch (executor
+    thread) with the PHYSICAL fan indices the launch runs on; a no-op
+    unless chaos installed a hook."""
+    hook = _launch_hook
+    if hook is not None:
+        hook(tuple(devices))
+
 
 class LaunchControl:
     """Host-side control block for ONE in-flight persistent launch.
@@ -79,9 +110,15 @@ class LaunchControl:
     the poll snapshot is a copy, so the device never sees a torn row.
     """
 
-    def __init__(self, rows: int, *, clock, n_dev: int = 1):
+    def __init__(self, rows: int, *, clock, n_dev: int = 1, fan_map=None):
         self.rows = rows
         self.n_dev = max(1, n_dev)
+        #: launch slice index -> PHYSICAL fan device index. A degraded-width
+        #: launch (quarantined devices excluded) runs on a subset of the
+        #: fan, so the pmap axis index the device polls with is not the
+        #: device's identity; chaos hooks and the watchdog's health
+        #: bookkeeping both key on the physical index.
+        self.fan_map = list(fan_map) if fan_map is not None else None
         self._clock = clock
         self._lock = threading.Lock()
         self._arr = np.zeros((self.n_dev, rows, CTRL_WORDS), dtype=np.uint32)
@@ -117,6 +154,17 @@ class LaunchControl:
         self._applied_k: Dict[tuple, int] = {}
         self.polls = 0  # device-side control reads served (all devices)
         self.last_k = 0  # highest window index any device polled at
+        #: per-device liveness bookkeeping (launch slice index): last poll
+        #: stamp on the injectable clock and last polled window — the
+        #: progress signal the engine watchdog (resilience/devfault.py)
+        #: derives device health from
+        self.poll_t: Dict[int, float] = {}
+        self.poll_k: Dict[int, int] = {}
+        #: clock stamp of the launch's very first poll on ANY device —
+        #: None while XLA compile + dispatch still sit in front of the
+        #: program (the watchdog grants that phase a grace deadline: a
+        #: cold compile must not read as a dead device)
+        self.first_poll_t: Optional[float] = None
         #: (row, dev) -> window index at which that device reported the
         #: row done (or will deterministically stop it: delivered cancel)
         self.done_at_k: Dict[tuple, int] = {}
@@ -213,6 +261,10 @@ class LaunchControl:
         with self._lock:
             self.polls += 1
             self.last_k = max(self.last_k, int(k))
+            self.poll_t[dev] = self._clock.time()
+            self.poll_k[dev] = max(self.poll_k.get(dev, 0), int(k))
+            if self.first_poll_t is None:
+                self.first_poll_t = self.poll_t[dev]
             for row in range(min(self.rows, done.shape[0])):
                 if done[row]:
                     self.done_at_k.setdefault((row, dev), int(k))
@@ -305,6 +357,48 @@ class LaunchControl:
         with self._lock:
             return self._applied_k.get((row, min(dev, self.n_dev - 1)), 0)
 
+    def last_poll(self, dev: int) -> tuple:
+        """(clock stamp, window index) of device ``dev``'s newest control
+        poll, or (None, -1) when it has not polled yet."""
+        with self._lock:
+            return self.poll_t.get(dev), self.poll_k.get(dev, -1)
+
+    def device_accounted(self, dev: int, max_steps: int, poll_steps: int) -> bool:
+        """True when device ``dev``'s silence needs no explanation: every
+        one of its rows is known done (cancelled or reported done at a
+        poll), or it already cleared its FINAL poll block — at most
+        ``poll_steps`` windows remain after that checkpoint, after which
+        the device exits its loop and legitimately never polls again.
+        A device that is neither is expected to keep polling; the engine
+        watchdog treats its silence as missed progress."""
+        with self._lock:
+            if all(
+                (row, dev) in self.done_at_k for row in range(self.rows)
+            ):
+                return True
+            last_k = self.poll_k.get(dev)
+            return last_k is not None and last_k + poll_steps >= max_steps
+
+    def confirmed_no_hit_windows(self, row: int, dev: int, poll_steps: int) -> int:
+        """Windows device ``dev`` PROVABLY scanned dry for ``row`` — the
+        safe re-cover frontier when a launch's results are being discarded
+        (watchdog evacuation): a poll at window k with the row still live
+        proves windows [0, k) held no hit. If the row went done at a poll
+        (a hit somewhere in the preceding poll block, or a cancel), only
+        the windows before that block are provably dry."""
+        with self._lock:
+            key = (row, min(dev, self.n_dev - 1))
+            done_k = self.done_at_k.get(key)
+            if done_k is not None:
+                return max(0, done_k - max(1, poll_steps))
+            return self.poll_k.get(min(dev, self.n_dev - 1), 0)
+
+    def kill_all(self) -> None:
+        """Fence every row (see :meth:`kill`) — the whole launch is stale
+        (evacuated or abandoned) and must neither be steered nor grind on."""
+        for row in range(self.rows):
+            self.kill(row)
+
     def windows_run(self, row: int, max_steps: int, dev: int = 0) -> int:
         """Upper bound on windows device ``dev`` actually scanned for the
         row — its ``done_at_k`` when it reported the row done mid-launch
@@ -340,4 +434,12 @@ def poll_slot(slot, dev, k, done) -> np.ndarray:
         ctrl = _slots.get(int(slot))
     if ctrl is None:
         return np.zeros((done.shape[0], CTRL_WORDS), dtype=np.uint32)
+    hook = _poll_hook
+    if hook is not None:
+        # Chaos seam, OUTSIDE both locks (it may block — that is the
+        # injected fault). The hook sees the device's PHYSICAL fan index.
+        phys = int(dev)
+        if ctrl.fan_map is not None and phys < len(ctrl.fan_map):
+            phys = ctrl.fan_map[phys]
+        hook(int(slot), phys, int(k))
     return ctrl.poll(int(dev), int(k), done)

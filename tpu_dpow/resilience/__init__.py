@@ -11,6 +11,11 @@ exported metric:
                (server-side; wired in server/app.py);
   breaker    — :class:`CircuitBreaker`: closed/open/half-open with a
                probe, on an injectable clock;
+  devfault   — :class:`DeviceFaultDomains`: per-device healthy/suspect/
+               quarantined state for the engine fan, with single-probe
+               re-admission riding a per-device breaker (the engine
+               watchdog in backend/jax_backend.py observes progress and
+               evacuates — docs/resilience.md "Device fault domains");
   failover   — :class:`FailoverBackend`: jax → native → error engine
                chain behind per-engine breakers (client-side);
   clock      — :class:`SystemClock` / :class:`FakeClock`: the injectable
@@ -28,5 +33,12 @@ See docs/resilience.md for the state machines and the metric families.
 from ..store.degraded import DegradedStore  # noqa: F401
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: F401
 from .clock import Clock, FakeClock, SystemClock  # noqa: F401
+from .devfault import (  # noqa: F401
+    HEALTHY,
+    QUARANTINED,
+    SUSPECT,
+    DeviceFaultDomains,
+    launch_deadline,
+)
 from .failover import FailoverBackend  # noqa: F401
 from .supervisor import DispatchSupervisor  # noqa: F401

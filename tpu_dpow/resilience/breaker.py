@@ -98,6 +98,17 @@ class CircuitBreaker:
         self._probe_inflight = False
         self._transition(CLOSED)
 
+    def trip(self) -> None:
+        """Force the breaker OPEN now, regardless of the failure count —
+        the dependency itself declared it cannot serve (e.g. an engine's
+        zero-healthy-devices signal, backend.DevicesExhausted). The normal
+        reset_timeout → half-open → probe path re-admits it."""
+        self._m_failures.inc(1, self.name)
+        self.failures = self.failure_threshold
+        self._probe_inflight = False
+        self._opened_at = self.clock.time()
+        self._transition(OPEN)
+
     def record_failure(self) -> None:
         self._m_failures.inc(1, self.name)
         self._probe_inflight = False

@@ -27,7 +27,7 @@ import asyncio
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import obs
-from ..backend import WorkBackend, WorkCancelled, WorkError
+from ..backend import DevicesExhausted, WorkBackend, WorkCancelled, WorkError
 from ..models import WorkRequest
 from ..utils.logging import get_logger
 from .breaker import CircuitBreaker
@@ -153,6 +153,19 @@ class FailoverBackend(WorkBackend):
                     await backend.cancel(block_hash)
                 except Exception:
                     pass
+            except DevicesExhausted as e:
+                # The engine's own fault domains already declared every
+                # device quarantined (backend/jax_backend.py watchdog):
+                # don't wait out hang_timeout or burn failure_threshold
+                # requests probing a backend that knows it is dead — trip
+                # the breaker NOW and serve from the next engine. The
+                # breaker's normal reset → half-open probe re-admits it
+                # (by then a successful device probe usually has, too).
+                breaker.trip()
+                self._m_failover.inc(1, name, "devices_exhausted")
+                last_error = e
+                logger.error("engine %s has zero healthy devices on %s; "
+                             "breaker tripped, failing over", name, block_hash)
             except WorkError as e:
                 breaker.record_failure()
                 self._m_failover.inc(1, name, "error")

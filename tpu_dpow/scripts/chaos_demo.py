@@ -387,6 +387,142 @@ async def fleet_scenario() -> dict:
     }
 
 
+DEVICE_FAMILIES = (
+    "dpow_backend_device_health",
+    "dpow_backend_evacuations_total",
+    "dpow_backend_quarantine_total",
+    "dpow_backend_launch_threads_leaked_total",
+    "dpow_chaos_injected_total",
+)
+
+
+async def device_scenario() -> dict:
+    """Device fault domains end to end (docs/resilience.md): an 8-way
+    persistent fan loses device 3 mid-launch (it stops polling — the TPU
+    preemption presentation), the watchdog declares it suspect, evacuates
+    its uncovered nonce range onto the 7 healthy devices, the solve lands
+    from the evacuated range at degraded width, the zombie wake-up bounces
+    off the kill fence, and a successful probe re-admits the device.
+    FakeClock: the suspect deadline and probe interval play out in
+    milliseconds."""
+    import hashlib as _hl
+    import itertools as _it
+
+    import jax
+
+    from ..backend.jax_backend import JaxWorkBackend
+    from ..chaos import FaultyDevice
+    from ..models import WorkRequest
+    from ..resilience import HEALTHY, QUARANTINED
+
+    obs.reset()
+    clock = FakeClock()
+    n = min(8, len(jax.local_devices()))
+    victim = min(3, n - 1)
+    log: list = []
+    val = nc.work_value_int  # planted-difficulty arithmetic on raw nonces
+
+    b = JaxWorkBackend(
+        kernel="xla", sublanes=8, iters=8, devices=n, max_batch=1,
+        run_mode="persistent", persistent_steps=4, control_poll_steps=1,
+        pipeline=1, clock=clock,
+        device_suspect_after=10.0, device_probe_interval=30.0,
+    )
+    await b.setup()
+    span_dev = b.chunk_per_shard
+    hx = _hl.blake2b(b"chaos-devfault", digest_size=32).hexdigest().upper()
+    h = bytes.fromhex(hx)
+    S, stride = 1 << 40, 1 << 20
+    L = n * stride
+    # Plant the solution in the victim's UNCOVERED remainder: the floor
+    # covers everything any device can scan before the evacuation.
+    pre: list = []
+    for d in range(n):
+        width = 4 * span_dev if d != victim else 2 * span_dev
+        pre.extend(range(S + d * stride, S + d * stride + width))
+    floor = max(val(h, x) for x in pre)
+    f_dead = S + victim * stride + span_dev
+    planted = next(x for x in _it.count(f_dead) if val(h, x) > floor)
+    diff = val(h, planted)
+
+    async def spin(cond, msg, timeout=60.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not cond():
+            if asyncio.get_event_loop().time() >= deadline:
+                raise TimeoutError(msg)
+            await asyncio.sleep(0.005)
+
+    with FaultyDevice() as fd:
+        fd.hang_at_poll(victim, 2)
+        req = asyncio.ensure_future(
+            b.generate(WorkRequest(hx, diff, nonce_range=(S, L)))
+        )
+        await spin(
+            lambda: any(r.control is not None for r in b._inflight),
+            "no persistent launch",
+        )
+        rec = next(r for r in b._inflight if r.control is not None)
+        await spin(
+            lambda: ("poll", victim, 2) in fd.events,
+            f"device {victim} never wedged",
+        )
+        await spin(
+            lambda: all(
+                rec.control.device_accounted(s, 4, 1)
+                for s in range(n) if s != victim
+            ),
+            "healthy devices not accounted",
+        )
+        log.append(
+            f"{n}-way persistent fan launched; device {victim} wedged at "
+            f"its control poll (chaos hang-at-poll) while the other "
+            f"{n - 1} kept polling"
+        )
+        await clock.advance(13.0)
+        assert b._dfd.state(victim) == QUARANTINED
+        evacs = obs.get_registry().counter(
+            "dpow_backend_evacuations_total", labelnames=("reason",)
+        ).value("stalled_poll")
+        log.append(
+            f"watchdog: device {victim} suspect -> range "
+            f"[{f_dead:016x}, ...) evacuated onto {n - 1} healthy devices "
+            f"(evacuations_total={int(evacs)}) -> quarantined"
+        )
+        fd.release(victim)  # the zombie wakes against the kill fence
+        work = await asyncio.wait_for(req, 90)
+        nc.validate_work(hx, work, diff)
+        assert int(work, 16) >= f_dead
+        log.append(
+            f"solve {work} landed FROM THE EVACUATED RANGE at degraded "
+            f"fan width, inside the request's deadline; zombie launch "
+            f"drained without touching the frontier (epoch fence)"
+        )
+        while b._dfd.state(victim) != HEALTHY and not any(
+            not p.done() for p in b._probe_tasks.values()
+        ):
+            await clock.advance(2.6)
+        await spin(
+            lambda: b._dfd.state(victim) == HEALTHY, "probe never re-admitted"
+        )
+        log.append(
+            f"probe interval elapsed -> single-launch probe succeeded -> "
+            f"device {victim} re-admitted; fan back to full width {n}"
+        )
+    await b.close()
+
+    snapshot = obs.snapshot()
+    return {
+        "narrative": log,
+        "metrics": {
+            name: snapshot[name] for name in DEVICE_FAMILIES
+            if name in snapshot
+        },
+        "evacuations": snapshot[
+            "dpow_backend_evacuations_total"]["series"].get("stalled_poll", 0),
+        "readmitted": True,
+    }
+
+
 def main() -> int:
     result = asyncio.run(asyncio.wait_for(scenario(), timeout=60))
     print("=== chaos demo: drop / fail / recover ===")
@@ -412,7 +548,18 @@ def main() -> int:
     print(f"\nfleet scenario {'completed' if fleet_ok else 'FAILED'}: "
           f"sharded dispatch survived a mid-range worker death via "
           f"re-cover")
-    return 0 if (ok and fleet_ok) else 1
+
+    device = asyncio.run(asyncio.wait_for(device_scenario(), timeout=180))
+    print("\n=== chaos demo: device hang / evacuate / quarantine / probe ===")
+    for line in device["narrative"]:
+        print(f"  * {line}")
+    print("\n=== obs snapshot (device fault-domain families) ===")
+    print(json.dumps(device["metrics"], indent=2, sort_keys=True))
+    device_ok = device["readmitted"] and device["evacuations"] >= 1
+    print(f"\ndevice scenario {'completed' if device_ok else 'FAILED'}: "
+          f"the fan survived a mid-launch device hang via evacuation and "
+          f"probe re-admission")
+    return 0 if (ok and fleet_ok and device_ok) else 1
 
 
 if __name__ == "__main__":

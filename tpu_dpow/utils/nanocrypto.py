@@ -98,6 +98,17 @@ def work_value(block_hash: str, work: str) -> int:
     return int.from_bytes(digest, "little")
 
 
+def work_value_int(hash_bytes: bytes, nonce: int) -> int:
+    """:func:`work_value` for a raw int nonce + raw 32-byte hash — the
+    hot form planted-difficulty tests, demos and host-side brute loops
+    use (no hex round trip, no validation)."""
+    digest = hashlib.blake2b(
+        struct.pack("<Q", nonce & 0xFFFFFFFFFFFFFFFF) + hash_bytes,
+        digest_size=8,
+    ).digest()
+    return int.from_bytes(digest, "little")
+
+
 def validate_work(block_hash: str, work: str, difficulty: int | str = BASE_DIFFICULTY) -> str:
     """Raise InvalidWork unless the work meets the difficulty; returns work."""
     if isinstance(difficulty, str):

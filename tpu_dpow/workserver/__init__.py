@@ -91,6 +91,83 @@ def build_app(backend: WorkBackend) -> web.Application:
     return app
 
 
+class WorkServerProcess:
+    """Managed EXTERNAL work server: spawn a nano-work-server-compatible
+    child process (this module's own ``python -m tpu_dpow.workserver``, or
+    the reference's vendored binary) and guarantee bounded teardown.
+
+    The close path is the point (docs/resilience.md): ``terminate`` is a
+    polite SIGTERM, but a wedged child — stuck in a driver call, or simply
+    ignoring the signal — must not be awaited forever. ``stop`` escalates
+    to SIGKILL after ``terminate_grace`` and bounds the final wait too, so
+    shutdown always returns; a child that survives even SIGKILL's wait
+    window (unkillable D-state) is abandoned with an error log rather
+    than blocking the caller. The PR-8 detach-then-await hardening covered
+    tasks; this covers the subprocess wait itself.
+    """
+
+    def __init__(
+        self,
+        argv: list,
+        *,
+        terminate_grace: float = 5.0,
+        kill_grace: float = 5.0,
+    ):
+        self.argv = list(argv)
+        self.terminate_grace = terminate_grace
+        self.kill_grace = kill_grace
+        self._proc: Optional[asyncio.subprocess.Process] = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    @property
+    def returncode(self) -> Optional[int]:
+        return self._proc.returncode if self._proc is not None else None
+
+    async def start(self) -> None:
+        self._proc = await asyncio.create_subprocess_exec(*self.argv)
+
+    async def stop(self) -> bool:
+        """terminate → bounded wait → kill → bounded wait. True when the
+        child is confirmed gone; False when it was abandoned still
+        running (logged, never awaited forever)."""
+        # Detach-then-await (dpowlint DPOW801): one teardown per child
+        # even under concurrent stop() calls.
+        proc, self._proc = self._proc, None
+        if proc is None or proc.returncode is not None:
+            return True
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            return True
+        try:
+            await asyncio.wait_for(proc.wait(), self.terminate_grace)
+            return True
+        except asyncio.TimeoutError:
+            logger.warning(
+                "work server pid %d ignored SIGTERM for %.1fs; killing",
+                proc.pid, self.terminate_grace,
+            )
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            return True
+        try:
+            await asyncio.wait_for(proc.wait(), self.kill_grace)
+            return True
+        except asyncio.TimeoutError:
+            # Unkillable (D-state) child: abandon it — blocking shutdown
+            # on it would be strictly worse. The transport-less orphan is
+            # the kernel's to reap.
+            logger.error(
+                "work server pid %d survived SIGKILL for %.1fs; abandoned",
+                proc.pid, self.kill_grace,
+            )
+            return False
+
+
 class WorkServer:
     """Embeddable runner: serve a backend on host:port until stopped."""
 
