@@ -72,6 +72,18 @@ async def run(n: int, concurrency: int) -> None:
             {
                 "bench": "e2e_flood",
                 "platform": "tpu" if stack.on_tpu else "cpu",
+                # This harness is a bounded-concurrency CLOSED loop: when
+                # the stack slows, the generator slows with it, so the
+                # percentiles silently omit the requests that would have
+                # arrived meanwhile (coordinated omission). Fine for A/B
+                # deltas on one code base; capacity/SLO claims come from
+                # benchmarks/loadgen.py's open-loop captures instead.
+                "closed_loop": True,
+                "caveat": (
+                    "concurrency-bounded closed loop; latencies subject "
+                    "to coordinated omission — not comparable with "
+                    "open-loop (benchmarks/loadgen.py) captures"
+                ),
                 "n": n,
                 "concurrency": concurrency,
                 "ok": len(times),

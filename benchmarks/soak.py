@@ -91,6 +91,15 @@ async def run(waves: int, width: int) -> None:
     print(json.dumps({
         "bench": "chaos_soak",
         "platform": "tpu" if on_tpu else "cpu",
+        # Wave-synchronized CLOSED loop (each wave waits for the last):
+        # throughput here is an outcome-mix gate, not a capacity claim —
+        # open-loop capacity/SLO captures live in benchmarks/loadgen.py.
+        "closed_loop": True,
+        "caveat": (
+            "wave-synchronized closed loop; rates subject to coordinated "
+            "omission — not comparable with open-loop "
+            "(benchmarks/loadgen.py) captures"
+        ),
         "ops": waves * width,
         **results,
         "leaks": leaks,

@@ -62,6 +62,23 @@ DEVFAULT=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
     'devfault or device or workserver_process' \
     --collect-only -q -p no:cacheprovider 2>/dev/null | grep -c '::' || true)
 echo "DEVFAULT=${DEVFAULT}"
+# Open-loop loadgen + autoscaler headline (ISSUE 14): the FakeClock
+# open-loop smoke against the real server and the sim spike acceptance
+# (controller scales 1→3 on a 10x flash crowd, journal replays), re-run
+# standalone so the headline is pass/fail, not a log grep. The 1M
+# capture itself is slow-marked (benchmarks/loadgen.py; BENCH_r14).
+if timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_loadgen.py::test_open_loop_smoke_against_real_server_fakeclock \
+    "tests/test_autoscale.py::test_sim_spike_without_controller_breaches_with_controller_holds" \
+    -q -p no:cacheprovider >/dev/null 2>&1; then
+    LOADGEN_TESTS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_loadgen.py tests/test_autoscale.py -m 'not slow' \
+        --collect-only -q -p no:cacheprovider \
+        2>/dev/null | grep -c '::' || true)
+    echo "LOADGEN=pass tests=${LOADGEN_TESTS}"
+else
+    echo "LOADGEN=fail"
+fi
 # dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
 # or how many findings escaped the baseline (docs/analysis.md).
 DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)

@@ -1,0 +1,507 @@
+"""Open-loop load harness (ISSUE 14): schedules, population, recorder,
+driver, and the FakeClock end-to-end smoke (the tier-1 LOADGEN headline).
+
+Everything timer-shaped rides FakeClock — a "minute" of open-loop traffic
+plays out in milliseconds of wall clock, deterministically.
+"""
+
+import asyncio
+import itertools
+import json
+
+import pytest
+
+from tpu_dpow import obs
+from tpu_dpow.loadgen import (
+    Arrival,
+    ConstantRate,
+    DiurnalRate,
+    HttpPostDriver,
+    InprocDriver,
+    OpenLoopDriver,
+    OpenLoopRecorder,
+    ServicePopulation,
+    SpikeOverlay,
+    SyntheticResponder,
+    TraceError,
+    parse_trace,
+    poisson_schedule,
+    trace_schedule,
+)
+from tpu_dpow.loadgen.driver import classify_response
+from tpu_dpow.resilience import FakeClock
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+# ---------------------------------------------------------------------------
+# arrival schedules
+# ---------------------------------------------------------------------------
+
+
+def test_poisson_schedule_stats_and_determinism():
+    a = list(poisson_schedule(50.0, n=2000, seed=9))
+    b = list(poisson_schedule(50.0, n=2000, seed=9))
+    c = list(poisson_schedule(50.0, n=2000, seed=10))
+    assert a == b, "same seed must reproduce the schedule exactly"
+    assert a != c
+    ts = [x.t for x in a]
+    assert ts == sorted(ts) and len(ts) == 2000
+    mean_gap = ts[-1] / len(ts)
+    # mean inter-arrival 1/50 s, generous tolerance for 2000 samples
+    assert 0.016 < mean_gap < 0.024
+
+
+def test_diurnal_rate_shape_and_spike_overlay():
+    r = DiurnalRate(5.0, 50.0, period=600.0)
+    assert r.rate(0.0) == pytest.approx(5.0)        # trough at t=0
+    assert r.rate(300.0) == pytest.approx(50.0)     # crest half a period in
+    assert r.rate(600.0) == pytest.approx(5.0)
+    s = SpikeOverlay(r, at=300.0, duration=30.0, factor=10.0)
+    assert s.rate(299.0) == pytest.approx(r.rate(299.0))
+    assert s.rate(301.0) == pytest.approx(r.rate(301.0) * 10.0)
+    assert s.rate(331.0) == pytest.approx(r.rate(331.0))
+    assert s.ceiling() == pytest.approx(500.0)
+
+
+def test_nonhomogeneous_poisson_tracks_the_rate_function():
+    r = DiurnalRate(2.0, 40.0, period=400.0)
+    arrivals = list(poisson_schedule(r, duration=400.0, seed=4))
+    trough = sum(1 for a in arrivals if a.t < 100.0)
+    crest = sum(1 for a in arrivals if 150.0 <= a.t < 250.0)
+    # crest window carries several times the trough window's arrivals
+    assert crest > 4 * max(trough, 1)
+
+
+# ---------------------------------------------------------------------------
+# trace replay (satellite: line-numbered refusal of bad traces)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_parse_roundtrip_with_overrides():
+    text = "\n".join([
+        "# a comment line",
+        json.dumps({"t": 0.5}),
+        json.dumps({"t": 1.0, "service": "svc-00001",
+                    "hash": "AB" * 32, "timeout": 3.5}),
+        "",
+        json.dumps({"t": 1.0}),  # equal timestamps are legal (a burst)
+    ])
+    events = parse_trace(text)
+    assert [e.t for e in events] == [0.5, 1.0, 1.0]
+    assert events[1].service == "svc-00001"
+    assert events[1].hash == "AB" * 32
+    assert events[1].timeout == 3.5
+
+
+def test_trace_rejects_non_monotonic_with_line_number():
+    text = '{"t": 1.0}\n{"t": 2.0}\n{"t": 1.5}'
+    with pytest.raises(TraceError) as e:
+        parse_trace(text)
+    msg = str(e.value)
+    assert "line 3" in msg and "backwards" in msg and "line 2" in msg
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ('{"t": 1.0}\nnot json', "line 2"),
+    ('{"x": 1.0}', 'line 1'),
+    ('{"t": "soon"}', "line 1"),
+    ('{"t": -1.0}', "line 1"),
+    ('{"t": NaN}', "line 1"),
+    ('{"t": 1.0, "timeout": 0}', "line 1"),
+])
+def test_trace_rejects_malformed_lines(bad, needle):
+    with pytest.raises(TraceError) as e:
+        parse_trace(bad)
+    assert needle in str(e.value)
+
+
+def test_trace_schedule_time_scale_and_repeat():
+    text = '{"t": 0.0}\n{"t": 10.0}'
+    out = list(trace_schedule(text, time_scale=0.1, repeat=2))
+    assert [round(a.t, 6) for a in out] == [0.0, 1.0, 1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# population
+# ---------------------------------------------------------------------------
+
+
+def test_population_determinism_and_behavior():
+    sched = list(poisson_schedule(20.0, n=600, seed=2))
+    p1 = ServicePopulation(40, seed=5)
+    p2 = ServicePopulation(40, seed=5)
+    s1 = [p1.spec(a) for a in sched]
+    s2 = [p2.spec(a) for a in sched]
+    assert s1 == s2, "same (n_services, seed) must reproduce the stream"
+    # Zipf skew: the most popular service dwarfs the median one
+    from collections import Counter
+
+    by_svc = Counter(s.service for s in s1)
+    top = by_svc.most_common(1)[0][1]
+    assert top > 10 * (sorted(by_svc.values())[len(by_svc) // 2])
+    # hash reuse exists (store hits / coalescing downstream) but is bounded
+    dup = len(s1) - len({s.hash for s in s1})
+    assert 0 < dup < len(s1) // 2
+    # cancels are a small intended fraction, always before the timeout
+    cancels = [s for s in s1 if s.cancel_after is not None]
+    assert 0 < len(cancels) < len(s1) // 4
+    assert all(s.cancel_after < s.timeout for s in cancels)
+    assert all(1.0 <= s.timeout <= 30.0 for s in s1)
+
+
+def test_population_seed_store_registers_quota_identities():
+    from tpu_dpow.store import MemoryStore
+
+    pop = ServicePopulation(7, seed=1)
+
+    async def main():
+        store = MemoryStore()
+        n = await pop.seed_store(store)
+        assert n == 7
+        services = await store.smembers("services")
+        assert len(services) == 7
+        rec = await store.hgetall("service:svc-00003")
+        assert rec["api_key"] and rec["api_key"] != "key-00003"  # hashed
+
+    run(main())
+
+
+def test_trace_service_override_wins_over_sampling():
+    pop = ServicePopulation(5, seed=0)
+    spec = pop.spec(Arrival(1.0, service="svc-00004", hash="CD" * 32,
+                            timeout=9.0))
+    assert spec.service == "svc-00004"
+    assert spec.hash == "CD" * 32
+    assert spec.timeout == 9.0
+
+
+# ---------------------------------------------------------------------------
+# recorder: coordinated-omission safety
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_measures_from_intended_arrival():
+    obs.reset()
+    clock = FakeClock()
+    rec = OpenLoopRecorder(clock, window=5.0)
+
+    async def main():
+        rec.begin()  # schedule t=0 at clock 0
+        # the driver stalls: a request INTENDED for t=1 is issued at t=3
+        await clock.advance(3.0)
+        rec.issued(1.0)
+        assert rec.max_lag == pytest.approx(2.0)
+        # ... and completes at t=5: latency is 4s from intent, not 2s
+        await clock.advance(2.0)
+        latency = rec.done(1.0, "ok")
+        assert latency == pytest.approx(4.0)
+
+    run(main())
+    s = rec.summary(slo_p95_ms=1000.0)
+    assert s["n"] == 1 and s["outcomes"] == {"ok": 1}
+    assert s["max_issue_lag_ms"] == pytest.approx(2000.0)
+    assert s["p95_ms"] >= 4000.0  # bucket upper edge: pessimistic, never rosy
+    assert s["measured_from"] == "intended_arrival"
+    assert s["slo"]["overall_met"] is False
+
+
+def test_recorder_timeline_windows_and_slo_grading():
+    obs.reset()
+    clock = FakeClock()
+    rec = OpenLoopRecorder(clock, window=10.0)
+    rec.begin(0.0)
+    # window 0: fast; window 1: slow
+    for i in range(20):
+        rec.done(float(i % 10), "ok", end_t=(i % 10) + 0.05, issued=False)
+    for i in range(20):
+        rec.done(10.0 + (i % 10), "ok", end_t=10.0 + (i % 10) + 3.0,
+                 issued=False)
+    rows = rec.timeline()
+    assert [r["t"] for r in rows] == [0.0, 10.0]
+    assert rows[0]["p95_ms"] < 100 < rows[1]["p95_ms"]
+    s = rec.summary(slo_p95_ms=1000.0)
+    assert s["slo"]["windows_total"] == 2
+    assert s["slo"]["windows_holding"] == 1
+    assert s["slo"]["window_hold_ratio"] == 0.5
+
+
+def test_recorder_refuses_unknown_outcome():
+    rec = OpenLoopRecorder(FakeClock())
+    rec.begin(0.0)
+    with pytest.raises(ValueError):
+        rec.done(0.0, "mystery")
+
+
+# ---------------------------------------------------------------------------
+# the open-loop driver on FakeClock
+# ---------------------------------------------------------------------------
+
+
+class _StubIssue:
+    """Records WHEN each request was issued on the fake clock and answers
+    after a per-spec delay."""
+
+    def __init__(self, clock, delay=0.0, outcome="ok"):
+        self.clock = clock
+        self.delay = delay
+        self.outcome = outcome
+        self.issued_at = []
+
+    async def __call__(self, spec):
+        self.issued_at.append((spec.intended_t, self.clock.time()))
+        if self.delay:
+            await self.clock.sleep(self.delay)
+        return self.outcome
+
+
+async def _drive(driver, schedule, clock, span, step=0.25):
+    task = asyncio.ensure_future(driver.run(schedule))
+    elapsed = 0.0
+    while not task.done() and elapsed < span:
+        await clock.advance(step)
+        elapsed += step
+    for _ in range(200):
+        if task.done():
+            break
+        await clock.advance(step)
+    return await task
+
+
+def test_driver_issues_on_intended_schedule():
+    obs.reset()
+    clock = FakeClock()
+    rec = OpenLoopRecorder(clock, window=5.0)
+    stub = _StubIssue(clock, delay=0.1)
+    pop = ServicePopulation(3, seed=0, cancel_rate=(0.0, 0.0))
+    driver = OpenLoopDriver(stub, rec, population=pop, clock=clock)
+    schedule = [Arrival(t) for t in (0.5, 1.0, 1.5, 2.0)]
+
+    summary = run(_drive(driver, schedule, clock, span=6.0))
+    assert driver.issued == 4 and summary["outcomes"] == {"ok": 4}
+    for intended, actual in stub.issued_at:
+        assert actual == pytest.approx(intended, abs=0.3)
+    # open loop: issue times follow the schedule, not each other — request
+    # 2 was issued before request 1's 0.1s service completed
+    assert summary["max_issue_lag_ms"] < 300
+
+
+def test_driver_timeout_and_cancel_outcomes():
+    obs.reset()
+    clock = FakeClock()
+    rec = OpenLoopRecorder(clock, window=5.0)
+    stub = _StubIssue(clock, delay=1000.0)  # never answers in time
+
+    class OnePop:
+        def __init__(self, cancel_after=None, timeout=2.0):
+            self.cancel_after = cancel_after
+            self.timeout = timeout
+
+        def spec(self, a):
+            from tpu_dpow.loadgen.population import RequestSpec
+
+            return RequestSpec(
+                intended_t=a.t, service="svc", api_key="k", hash="AB" * 32,
+                timeout=self.timeout, cancel_after=self.cancel_after,
+            )
+
+    d1 = OpenLoopDriver(stub, rec, population=OnePop(), clock=clock)
+    summary = run(_drive(d1, [Arrival(0.1)], clock, span=8.0, step=0.5))
+    assert summary["outcomes"] == {"timeout": 1}
+
+    obs.reset()
+    rec2 = OpenLoopRecorder(clock, window=5.0)
+    d2 = OpenLoopDriver(
+        stub, rec2, population=OnePop(cancel_after=0.5), clock=clock
+    )
+    summary2 = run(_drive(d2, [Arrival(0.1)], clock, span=4.0, step=0.25))
+    assert summary2["outcomes"] == {"cancelled": 1}
+    # the abandon is recorded at ITS time: ~0.5s after intent, not timeout
+    assert summary2["p95_ms"] < 1500
+
+
+def test_driver_safety_valve_records_shed_client():
+    obs.reset()
+    clock = FakeClock()
+    rec = OpenLoopRecorder(clock, window=5.0)
+    stub = _StubIssue(clock, delay=1000.0)
+    pop = ServicePopulation(2, seed=0, cancel_rate=(0.0, 0.0))
+    driver = OpenLoopDriver(
+        stub, rec, population=pop, clock=clock, max_inflight=2
+    )
+    schedule = [Arrival(0.1 * (i + 1)) for i in range(5)]
+    summary = run(_drive(driver, schedule, clock, span=40.0, step=1.0))
+    assert driver.shed_client == 3
+    assert summary["outcomes"]["shed_client"] == 3
+    assert summary["outcomes"]["timeout"] == 2  # the two issued ones
+    assert summary["n"] == 5  # accounting stays exhaustive
+
+
+def test_classify_response_contract():
+    assert classify_response(200, {"work": "ab", "hash": "CD"}) == "ok"
+    assert classify_response(429, {"error": "busy"}) == "busy"
+    assert classify_response(None, {"busy": True, "retry_after": 2}) == "busy"
+    assert classify_response(200, {"error": "Timeout reached without work",
+                                   "timeout": True}) == "timeout"
+    assert classify_response(200, {"error": "Invalid hash"}) == "error"
+    assert classify_response(200, "garbage") == "error"
+
+
+def test_http_driver_benches_dead_faces():
+    # no server listening anywhere: every face fails, outcome is error,
+    # and the faces are benched for the cooldown
+    obs.reset()
+    clock = FakeClock()
+    from tpu_dpow.loadgen.population import RequestSpec
+
+    driver = HttpPostDriver(
+        ["http://127.0.0.1:1", "http://127.0.0.1:2"],
+        clock=clock, face_cooldown=5.0,
+    )
+
+    async def main():
+        spec = RequestSpec(0.0, "svc", "k", "AB" * 32, 2.0)
+        out = await driver(spec)
+        assert out == "error"
+        assert driver.retries == 2
+        assert len(driver._dead_until) == 2
+        await driver.close()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# the FakeClock end-to-end smoke: open loop against the REAL server
+# (the tier-1 LOADGEN headline test)
+# ---------------------------------------------------------------------------
+
+
+def test_open_loop_smoke_against_real_server_fakeclock():
+    """A seconds-scale open-loop trace through the real DpowServer over
+    the in-proc broker with the synthetic responder: every arrival is
+    served or concluded cleanly, latencies are measured from intended
+    arrival, and same-hash reuse actually exercises the store-hit path."""
+    obs.reset()
+    from tpu_dpow.server import DpowServer, ServerConfig
+    from tpu_dpow.store import MemoryStore
+    from tpu_dpow.transport.broker import Broker
+    from tpu_dpow.transport.inproc import InProcTransport
+
+    clock = FakeClock()
+    broker = Broker()
+    store = MemoryStore()
+    config = ServerConfig(
+        base_difficulty=0xFF00000000000000,
+        throttle=100000.0,
+        heartbeat_interval=3600.0,
+        statistics_interval=3600.0,
+        work_republish_interval=2.0,
+        fleet=False,
+    )
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"),
+        clock=clock,
+    )
+    pop = ServicePopulation(
+        8, seed=3, reuse_prob=(0.3, 0.5), cancel_rate=(0.0, 0.05),
+        timeout_median=(8.0, 12.0),
+    )
+    rec = OpenLoopRecorder(clock, window=2.0)
+
+    async def main():
+        await server.setup()
+        server.start_loops()
+        await pop.seed_store(store)
+        responder = SyntheticResponder(
+            InProcTransport(broker, client_id="responder"),
+            latency=0.05, clock=clock,
+        )
+        await responder.start()
+        driver = OpenLoopDriver(
+            InprocDriver(server.service_handler), rec,
+            population=pop, clock=clock,
+        )
+        schedule = poisson_schedule(10.0, n=60, seed=11)
+        try:
+            summary = await _drive(driver, schedule, clock, span=30.0)
+        finally:
+            await responder.close()
+            await server.close()
+        return summary
+
+    summary = run(main())
+    out = summary["outcomes"]
+    assert summary["n"] == 60
+    assert set(out) <= {"ok", "cancelled"}
+    assert out["ok"] >= 50
+    assert summary["max_issue_lag_ms"] < 1000
+    # served within the responder latency + a couple of clock steps
+    assert summary["p95_ms"] < 3000
+    snap = obs.snapshot()
+    served = snap["dpow_server_requests_total"]["series"]
+    # hash reuse hit the precache/store path at least once
+    assert served.get("precache", 0) >= 1
+    assert snap["dpow_loadgen_requests_total"]["series"]["ok"] == out["ok"]
+
+
+def test_ws_driver_round_trip_against_real_face():
+    """The websocket driver speaks the real /service_ws/ face (id
+    correlation, busy frames pass through classify_response)."""
+    obs.reset()
+    from tpu_dpow.loadgen import WsDriver
+    from tpu_dpow.loadgen.population import RequestSpec
+    from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+    from tpu_dpow.server.api import ServerRunner
+    from tpu_dpow.store import MemoryStore
+    from tpu_dpow.transport.broker import Broker
+    from tpu_dpow.transport.inproc import InProcTransport
+
+    clock = FakeClock()  # server timers; the ws RTT itself is real
+    broker = Broker()
+    store = MemoryStore()
+    config = ServerConfig(
+        base_difficulty=0xFF00000000000000,
+        throttle=100000.0,
+        heartbeat_interval=3600.0,
+        statistics_interval=3600.0,
+        fleet=False,
+        service_port=0, service_ws_port=0, upcheck_port=0, block_cb_port=0,
+    )
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"),
+        clock=clock,
+    )
+
+    async def main():
+        runner = ServerRunner(server, config)
+        await runner.start()
+        await store.hset(
+            "service:svc",
+            {"api_key": hash_key("secret"), "public": "N", "display": "svc",
+             "website": "", "precache": "0", "ondemand": "0"},
+        )
+        await store.sadd("services", "svc")
+        responder = SyntheticResponder(
+            InProcTransport(broker, client_id="responder"),
+            latency=0.0, clock=clock,
+        )
+        await responder.start()
+        ws = WsDriver(
+            [f"ws://127.0.0.1:{runner.ports['service_ws']}"],
+            clock=clock, conns_per_face=1,
+        )
+        try:
+            await ws.start()
+            outs = await asyncio.gather(*(
+                ws(RequestSpec(0.0, "svc", "secret", f"{i:02X}" * 32, 10.0))
+                for i in range(3)
+            ))
+            assert list(outs) == ["ok", "ok", "ok"]
+        finally:
+            await ws.close()
+            await responder.close()
+            await runner.stop()
+
+    run(main())

@@ -34,6 +34,12 @@ CONFIGS: Tuple[Tuple[str, str], ...] = (
     # analysis package is excluded from the code checkers but its flag
     # surface is an operator contract like any other
     ("sanitizer", "analysis/sanitizer.py"),
+    # ISSUE 14 surfaces. "responder" MUST precede "loadgen": its doc
+    # header names the module path (…loadgen.responder…), and section
+    # matching takes the first keyword that appears in the header.
+    ("responder", "loadgen/responder.py"),
+    ("loadgen", "loadgen/config.py"),
+    ("autoscale", "autoscale/config.py"),
 )
 
 _MISSING = object()

@@ -31,6 +31,7 @@ DOC_FILES = (
     "admission.md",
     "fleet.md",
     "replication.md",
+    "loadgen.md",
 )
 
 _KINDS = {"counter", "gauge", "histogram"}

@@ -61,6 +61,7 @@ INSTRUMENTED_PREFIXES = (
     "tpu_dpow/transport/inproc.py",
     "tpu_dpow/backend/jax_backend.py",
     "tpu_dpow/ops/control.py",
+    "tpu_dpow/autoscale/",
 )
 
 
@@ -97,8 +98,8 @@ def add_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--san", action="store_true",
         help="after the static pass, replay the coalescing, fleet "
-        "re-cover, replica-takeover and device-fault scenarios under "
-        "the seeded interleaving perturber",
+        "re-cover, replica-takeover, device-fault and autoscale-drain "
+        "scenarios under the seeded interleaving perturber",
     )
     p.add_argument(
         "--san_seeds", type=int,
@@ -759,11 +760,121 @@ async def scenario_devfault(perturber: Perturber) -> None:
         await b.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario: autoscale drain vs in-flight dispatch
+# ---------------------------------------------------------------------------
+
+
+async def scenario_autoscale(perturber: Perturber) -> None:
+    """The retire-after-drain contract (tpu_dpow/autoscale/) under
+    perturbation: a replica holding in-flight AND admission-queued
+    dispatches is told to drain at a seed-chosen instant while worker
+    results land and fresh arrivals race the toggle. Invariants: every
+    pre-drain request is served or fails cleanly (the drain must never
+    strand a waiter whose dispatch is already out); every post-drain
+    arrival gets the busy contract with reason=draining (never silently
+    dispatched on a retiring replica); the drain signal the actuator
+    polls (window inflight) really reaches zero; side tables torn down."""
+    from ..autoscale.signals import signals_from_snapshot
+    from ..sched import Busy
+    from ..server.app import WORK_PENDING
+    from ..server.exceptions import RequestTimeout, RetryRequest
+    from ..transport.mqtt_codec import encode_result_payload
+    from .. import obs
+
+    server, store, clock = await _start_server(
+        perturber, fleet=False, max_inflight_dispatches=2,
+    )
+    payout = _payout()
+    try:
+        hashes = [
+            _scenario_hash(perturber.seed * 31 + i, "autoscale")
+            for i in range(3)
+        ]
+        # three distinct hashes against a 2-slot window: one dispatch is
+        # QUEUED for admission when the drain lands — the exact
+        # scale-down-vs-inflight ordering the static analysis reasons about
+        reqs = [
+            asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 25}
+            ))
+            for h in hashes
+        ]
+        for _ in range(perturber.rng.randint(0, 60)):
+            await asyncio.sleep(0)
+        await perturber.point("autoscale.drain")
+        server.apply_control({"drain": True, "precache_shed": True})
+        # fresh arrivals race the toggle: all must get the busy contract
+        late = [
+            asyncio.ensure_future(server.service_handler(
+                {"user": "svc", "api_key": "secret",
+                 "hash": _scenario_hash(perturber.seed * 97 + i, "late"),
+                 "timeout": 25}
+            ))
+            for i in range(2)
+        ]
+        works = {h: solve(h, EASY_DIFFICULTY) for h in hashes}
+        everyone = reqs + late
+        for _ in range(2000):
+            if all(r.done() for r in everyone):
+                break
+            for h in hashes:
+                if await store.get(f"block:{h}") == WORK_PENDING:
+                    await server.client_result_handler(
+                        "result/ondemand",
+                        encode_result_payload(h, works[h], payout),
+                    )
+            await asyncio.sleep(0)
+        else:
+            stranded = [i for i, r in enumerate(everyone) if not r.done()]
+            raise SanitizerFailure(
+                f"requests {stranded} stranded across the drain — the "
+                "retire-after-drain contract lost a waiter"
+            )
+        for h, r in zip(hashes, await asyncio.gather(
+            *reqs, return_exceptions=True
+        )):
+            if r == {"work": works[h], "hash": h}:
+                continue
+            if isinstance(r, (RetryRequest, RequestTimeout, Busy)):
+                # Busy is legal ONLY for a request still awaiting
+                # admission when the drain landed... which cannot happen:
+                # draining gates ARRIVALS, not admitted work. Anything
+                # here but a clean timeout-class abort is a bug.
+                if isinstance(r, Busy):
+                    raise SanitizerFailure(
+                        f"pre-drain request for {h} bounced busy — drain "
+                        "must gate new arrivals, never admitted work"
+                    )
+                continue
+            raise SanitizerFailure(f"pre-drain request ended wrong: {r!r}")
+        for r in await asyncio.gather(*late, return_exceptions=True):
+            if not isinstance(r, Busy):
+                raise SanitizerFailure(
+                    f"post-drain arrival ended {r!r} — expected the busy "
+                    "contract (a retiring replica must not take new work)"
+                )
+        await _settle()
+        # the signal the actuator's retire loop polls must read drained
+        sig, _ = signals_from_snapshot(obs.snapshot(), t=clock.time())
+        if server.admission.window.inflight != 0 or sig.inflight != 0:
+            raise SanitizerFailure(
+                f"window still holds {server.admission.window.inflight} "
+                f"slot(s) (signal reads {sig.inflight}) after every "
+                "dispatch resolved — the actuator would SIGINT a replica "
+                "with live work"
+            )
+        _check_teardown(server)
+    finally:
+        await server.close()
+
+
 SCENARIOS: Dict[str, Callable] = {
     "coalesce": scenario_coalesce,
     "fleet_recover": scenario_fleet_recover,
     "takeover": scenario_takeover,
     "devfault": scenario_devfault,
+    "autoscale": scenario_autoscale,
 }
 
 

@@ -1,0 +1,44 @@
+"""SLO autoscaler: the feedback loop over the signals the stack exports.
+
+"Adapting Blockchain Technology for Scientific Computing" (PAPERS.md)
+frames PoW capacity as something to schedule against fluctuating demand;
+twelve PRs of obs/sched/fleet/replica work built every signal and every
+lever that needs — this package finally closes the loop:
+
+  signals     — one :class:`~.signals.Signals` row per poll, read from
+                ``obs.snapshot()`` in-process or scraped from N replicas'
+                ``/metrics`` pages (the same surface operators scrape —
+                no privileged side channel): windowed p95 from the
+                request-latency histogram deltas, sched queue depth and
+                window occupancy, coalesce rate, fleet hashrate, ring
+                liveness;
+  controller  — a deterministic state machine judging p95 against the SLO
+                with hysteresis (consecutive-poll streaks, not single
+                samples) and per-action cooldowns. Escalation under
+                sustained breach: shed precache admission → add a replica
+                → tighten ``fleet_horizon``. De-escalation only after the
+                system has DRAINED (queue empty, occupancy low) — a
+                scale-down that races in-flight dispatches is the classic
+                flapping bug, and the dpowsan ``autoscale`` scenario
+                perturbs exactly that ordering;
+  journal     — every decision appended to a replayable JSONL log:
+                ``replay()`` re-runs the same controller code over the
+                journaled signals and must reproduce the same verdicts
+                (pinned by test), so any production decision can be
+                re-judged offline;
+  actuator    — the levers: spawn/retire real ``python -m
+                tpu_dpow.server`` replica processes (retire = drain via
+                the /control/ face, then SIGINT so the replica leaves the
+                ring cleanly), and POST horizon/shed to every live
+                replica's /control/ face.
+
+``python -m tpu_dpow.autoscale`` runs the poll loop against live
+replicas (or ``--replay`` re-judges a journal); benchmarks/loadgen.py
+embeds the same objects for the BENCH_r14 capture. docs/loadgen.md has
+the state machine and the journal format.
+"""
+
+from .config import AutoscaleConfig, parse_args  # noqa: F401
+from .controller import Action, SLOController  # noqa: F401
+from .journal import DecisionJournal, replay  # noqa: F401
+from .signals import MetricsPoller, Signals, signals_from_snapshot  # noqa: F401

@@ -1,0 +1,59 @@
+"""Open-loop traffic generation: the million-user side of the benchmark story.
+
+Every flood in benchmarks/ before ISSUE 14 was CLOSED-LOOP: a fixed pool of
+coroutines fires a request, waits for the answer, fires the next. That
+measures the system at whatever rate the system itself permits — when the
+server slows down, the load generator politely slows down with it, and the
+latency numbers silently omit every request that *would* have arrived while
+the stack was wedged (coordinated omission). Fine for A/B deltas, useless
+for "heavy traffic from millions of users" (ROADMAP north star), where
+arrivals do not wait for anyone.
+
+This package is the open-loop replacement:
+
+  arrival     — arrival-schedule generators: homogeneous Poisson, diurnal
+                sinusoid (non-homogeneous Poisson via thinning), spike
+                overlays, and flash-crowd replay from a JSONL trace (the
+                parser refuses non-monotonic timestamps with a
+                line-numbered error instead of sleeping backwards);
+  population  — thousands of simulated services with per-service behavior:
+                Zipf popularity, hash-reuse probability (drives store hits
+                and same-hash coalescing), cancel rate, a per-request
+                timeout distribution, and a real quota identity (each
+                simulated service is registered in the store and metered
+                by tpu_dpow/sched/ like any paying customer);
+  recorder    — coordinated-omission-safe capture: every latency is
+                measured from the *intended* arrival time on the
+                injectable resilience.Clock, never from the moment the
+                generator got around to sending — a stalled driver shows
+                up as latency, not as missing samples;
+  driver      — the open-loop scheduler plus drivers that speak the real
+                faces: HTTP POST /service/ and the /service_ws/ websocket,
+                round-robin with failover across N replica processes;
+  responder   — a synthetic worker (real transport, fixed solve latency)
+                so orchestration-layer captures aren't confounded by
+                device compute;
+  sim         — a discrete-event twin of the replica ring (admission
+                window + queue + service-time model) that runs
+                million-request schedules in seconds of wall clock with
+                the real autoscale controller in the loop.
+
+``benchmarks/loadgen.py`` is the capture entry point (BENCH_r14);
+``tpu_dpow/autoscale/`` closes the feedback loop over the signals the
+stack already exports. docs/loadgen.md has the catalogue.
+"""
+
+from .arrival import (  # noqa: F401
+    Arrival,
+    ConstantRate,
+    DiurnalRate,
+    SpikeOverlay,
+    TraceError,
+    parse_trace,
+    poisson_schedule,
+    trace_schedule,
+)
+from .population import RequestSpec, ServicePopulation  # noqa: F401
+from .recorder import FINE_BUCKETS, OpenLoopRecorder  # noqa: F401
+from .driver import HttpPostDriver, InprocDriver, OpenLoopDriver, WsDriver  # noqa: F401
+from .responder import SyntheticResponder  # noqa: F401

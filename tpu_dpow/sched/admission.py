@@ -60,6 +60,11 @@ class AdmissionController:
         # Precache leases by block hash: released when the worker result
         # lands (or the frontier retires the hash), expired by the sweep.
         self._leases: Dict[str, Ticket] = {}
+        # Autoscale lever (docs/loadgen.md): while True, every precache
+        # admission is shed on arrival — precache is speculative capacity
+        # the controller reclaims first under a p95 breach. On-demand
+        # admission is untouched.
+        self.shed_precache = False
 
         reg = obs.get_registry()
         self._m_admitted = reg.counter(
@@ -175,6 +180,11 @@ class AdmissionController:
             difficulty=difficulty,
             enqueued_at=self.clock.time(),
         )
+        if self.shed_precache:
+            # the autoscaler closed precache admission: account the shed
+            # (the admitted/rejected/shed sum stays exhaustive) and refuse
+            self._event("shed", ticket)
+            return None
         if self.window.try_acquire(ticket):
             self._leases[key] = ticket
             return ticket

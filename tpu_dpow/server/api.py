@@ -59,6 +59,11 @@ async def _handle_service_request(server: DpowServer, data) -> dict:
             "error": "Service busy, retry later",
             "busy": True,
             "retry_after": max(1, math.ceil(e.retry_after)),
+            # why: "overloaded" / shed reasons / "draining". A draining
+            # replica is leaving rotation — clients with a server list
+            # (loadgen HttpPostDriver) retry another face immediately
+            # instead of backing off.
+            "reason": getattr(e, "reason", "overloaded"),
         }
         _responses_counter().inc(1, "busy")
     except RetryRequest:
@@ -140,6 +145,28 @@ def build_apps(server: DpowServer, broker=None):
         # health face stays truthful under FakeClock tests too.
         return web.Response(text=f"{server.clock.time() - server.last_block:.2f}")
 
+    async def control_get_handler(request: web.Request) -> web.Response:
+        return web.json_response(server.control_state())
+
+    async def control_post_handler(request: web.Request) -> web.Response:
+        # The autoscaler's levers (docs/loadgen.md): drain / precache
+        # shed / fleet horizon. Internal face only — this rides the
+        # upcheck port next to /metrics, never the public service port.
+        try:
+            data = await request.json()
+        except (ValueError, json.JSONDecodeError):
+            return web.json_response({"error": "Bad request (not json)"},
+                                     status=400)
+        if not isinstance(data, dict):
+            return web.json_response({"error": "Bad request (not object)"},
+                                     status=400)
+        try:
+            state = server.apply_control(data)
+        except (TypeError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        logger.info("control applied: %s -> %s", data, state)
+        return web.json_response(state)
+
     async def block_cb_handler(request: web.Request) -> web.Response:
         try:
             data = await request.json()
@@ -163,6 +190,12 @@ def build_apps(server: DpowServer, broker=None):
     upcheck_app.router.add_get("/upcheck/blocks", upcheck_blocks_handler)
     upcheck_app.router.add_get("/upcheck/broker/", upcheck_broker_handler)
     upcheck_app.router.add_get("/upcheck/broker", upcheck_broker_handler)
+    # Autoscaler control face (tpu_dpow/autoscale/, docs/loadgen.md) —
+    # on the internal port, like /metrics.
+    upcheck_app.router.add_get("/control/", control_get_handler)
+    upcheck_app.router.add_get("/control", control_get_handler)
+    upcheck_app.router.add_post("/control/", control_post_handler)
+    upcheck_app.router.add_post("/control", control_post_handler)
     # Prometheus scrape surface, on the port that is already the internal
     # health face (never the public service port): request/result/dispatch
     # counters, per-stage span histograms, engine + broker internals.

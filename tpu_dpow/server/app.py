@@ -216,6 +216,14 @@ class DpowServer:
         # will ever tear them down — the supervisor's abandon hook (at
         # deadline) or _maybe_finish_adopted (on resolve) is their reaper.
         self._adopted_orphan: Set[str] = set()
+        # Runtime control levers (POST /control/ on the upcheck face,
+        # docs/loadgen.md): a draining replica refuses NEW service work
+        # with the standard busy shape — open-loop clients fail over to
+        # another face — while in-flight dispatches run to completion
+        # (the autoscale actuator's retire-after-drain contract). The
+        # precache-shed and fleet-horizon levers live on the admission
+        # controller and the fleet planner respectively.
+        self.draining = False
         self.service_throttlers: Dict[str, Throttler] = {}
         self.last_block: Optional[float] = None
         self.work_republished = 0  # healed lost publishes (observability)
@@ -259,6 +267,45 @@ class DpowServer:
             "dpow_coalesce_total",
             "On-demand requests served by another request's dispatch "
             "instead of their own, by how they joined", ("outcome",))
+        self._m_draining = reg.gauge(
+            "dpow_server_draining",
+            "1 while this replica refuses new service work pending "
+            "retirement (the /control/ drain lever)")
+        self._m_draining.set(0.0)
+
+    # ------------------------------------------------------------------
+    # runtime control (POST /control/ on the upcheck face)
+    # ------------------------------------------------------------------
+
+    def control_state(self) -> dict:
+        """The levers' current positions (GET /control/)."""
+        return {
+            "draining": self.draining,
+            "precache_shed": bool(
+                getattr(self.admission, "shed_precache", False)
+            ),
+            "fleet_horizon": self.fleet.planner.horizon,
+        }
+
+    def apply_control(self, data: dict) -> dict:
+        """Apply the autoscaler's levers (docs/loadgen.md). Unknown keys
+        are refused so a typo'd lever never silently no-ops. Returns the
+        post-apply state."""
+        known = {"drain", "precache_shed", "fleet_horizon"}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown control field(s): {sorted(unknown)}")
+        if "fleet_horizon" in data:
+            horizon = float(data["fleet_horizon"])
+            if horizon < 0:
+                raise ValueError("fleet_horizon must be >= 0")
+            self.fleet.planner.horizon = horizon
+        if "precache_shed" in data:
+            self.admission.shed_precache = bool(data["precache_shed"])
+        if "drain" in data:
+            self.draining = bool(data["drain"])
+            self._m_draining.set(1.0 if self.draining else 0.0)
+        return self.control_state()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1349,6 +1396,12 @@ class DpowServer:
             )
 
     async def _service_request(self, data: dict, served: dict) -> dict:
+        if self.draining:
+            # Retire-after-drain (autoscale actuator contract): this
+            # replica is leaving rotation — refuse new work with the
+            # standard busy shape so callers fail over to another face;
+            # dispatches already in flight keep running to completion.
+            raise Busy(self.config.busy_retry_after, reason="draining")
         if not {"hash", "user", "api_key"} <= data.keys():
             raise InvalidRequest(
                 "Incorrect submission. Required information: user, api_key, hash"
