@@ -79,16 +79,21 @@ if timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
 else
     echo "LOADGEN=fail"
 fi
-# dpowlint headline (ISSUE 5): the repo's own invariant checkers — clean,
-# or how many findings escaped the baseline (docs/analysis.md).
+# dpowlint headline (ISSUE 5, families since ISSUE 15): the repo's own
+# invariant checkers — clean or the escaped-finding count, plus the
+# active checker-family count parsed from the run's own summary line, so
+# a silently-skipped family shows up as a changed families= number
+# instead of an invisible gap (docs/analysis.md). Always the FULL run —
+# lint.sh is the --changed_only fast path.
 DPOWLINT_OUT=$(timeout -k 5 60 python -m tpu_dpow.analysis 2>&1)
 dlrc=$?
+DLFAM=$(printf '%s\n' "$DPOWLINT_OUT" | grep -o 'families=[0-9]*' | head -1)
 if [ "$dlrc" -eq 0 ]; then
-    echo "DPOWLINT=clean"
+    echo "DPOWLINT=clean ${DLFAM:-families=?}"
 else
     DLCOUNT=$(printf '%s\n' "$DPOWLINT_OUT" | grep -c '  DPOW')
     if [ "$DLCOUNT" -gt 0 ]; then
-        echo "DPOWLINT=${DLCOUNT} findings"
+        echo "DPOWLINT=${DLCOUNT} findings ${DLFAM:-families=?}"
     else
         # nonzero exit with zero findings = the linter itself broke
         # (crash/timeout); never report that as near-clean

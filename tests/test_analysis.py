@@ -7,6 +7,7 @@ fire and one known-good that MUST NOT — a checker that silently stops
 matching is caught here, not in review.
 """
 
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -15,6 +16,9 @@ import pytest
 
 from tpu_dpow.analysis import (
     CHECKERS,
+    FAMILIES,
+    KNOWN_CODES,
+    atomicity,
     blocking,
     clock,
     concurrency,
@@ -25,6 +29,7 @@ from tpu_dpow.analysis import (
     sanitizer,
     tasks,
     topics,
+    tracing,
 )
 from tpu_dpow.analysis.core import Baseline, Finding, Project, run_all
 
@@ -989,6 +994,14 @@ def test_sanitizer_annotates_static_findings():
     f_hot = Finding("tpu_dpow/sched/window.py", 20, "DPOW801", "m2")
     f_cold = Finding("tpu_dpow/client/app.py", 30, "DPOW801", "m3")
     f_other = Finding("tpu_dpow/server/app.py", 40, "DPOW802", "m4")
+    # ISSUE 15: DPOW1001 epoch-fence candidates ride the same annotate
+    # pass — the device-fault/takeover scenarios drive exactly the
+    # stale-epoch apply paths the fence checker reasons about.
+    f_fence_hit = Finding("tpu_dpow/server/app.py", 50, "DPOW1001", "m5")
+    f_fence_hot = Finding(
+        "tpu_dpow/backend/jax_backend.py", 60, "DPOW1001", "m6"
+    )
+    f_fence_cold = Finding("tpu_dpow/client/app.py", 70, "DPOW1001", "m7")
     report = sanitizer.SanitizerReport(
         runs=[
             sanitizer.SeedRun(
@@ -998,11 +1011,18 @@ def test_sanitizer_annotates_static_findings():
             sanitizer.SeedRun("coalesce", 1, True, "e"),
         ]
     )
-    verdicts = sanitizer.annotate([f_hit, f_hot, f_cold, f_other], report)
+    verdicts = sanitizer.annotate(
+        [f_hit, f_hot, f_cold, f_other, f_fence_hit, f_fence_hot,
+         f_fence_cold],
+        report,
+    )
     assert verdicts[f_hit.key()] == sanitizer.CONFIRMED
     assert verdicts[f_hot.key()] == sanitizer.NOT_REPRODUCED
     assert verdicts[f_cold.key()] == sanitizer.UNEXERCISED
-    assert f_other.key() not in verdicts  # only the 801 race class
+    assert f_other.key() not in verdicts  # only the annotated race classes
+    assert verdicts[f_fence_hit.key()] == sanitizer.CONFIRMED
+    assert verdicts[f_fence_hot.key()] == sanitizer.NOT_REPRODUCED
+    assert verdicts[f_fence_cold.key()] == sanitizer.UNEXERCISED
 
 
 # ---------------------------------------------------------------------------
@@ -1029,7 +1049,10 @@ def test_inline_waiver_same_line_and_line_above(tmp_path):
     assert len(found) == 1 and found[0].line == 7
 
 
-def test_waiver_is_code_specific(tmp_path):
+def test_waiver_is_code_specific_and_unknown_code_is_a_finding(tmp_path):
+    """A waiver naming the wrong code suppresses nothing — and since
+    ISSUE 15 the bogus code is ITSELF a finding (DPOW002 unknown-code),
+    not just a silent no-op comment."""
     project = make_project(
         tmp_path,
         {
@@ -1040,7 +1063,9 @@ def test_waiver_is_code_specific(tmp_path):
             )
         },
     )
-    assert len(run_all(project, [clock.check])) == 1
+    found = run_all(project, [clock.check])
+    assert codes(found) == ["DPOW002", "DPOW101"]
+    assert any("DPOW999" in f.message for f in found)
 
 
 def test_baseline_round_trip(tmp_path):
@@ -1098,8 +1123,805 @@ def test_cli_entrypoint(args, rc):
     )
     assert proc.returncode == rc, proc.stdout + proc.stderr
     if "--list" in args:
-        # the catalogue names every shipped family, 8xx included
-        for code in ("DPOW101", "DPOW801", "DPOW802", "DPOW803"):
+        # the catalogue names every shipped family, 10xx + meta included
+        for code in (
+            "DPOW101", "DPOW801", "DPOW802", "DPOW803", "DPOW002",
+            "DPOW1001", "DPOW1002", "DPOW1003", "DPOW1004", "DPOW1005",
+        ):
             assert code in proc.stdout
+    else:
+        # the family headline run_tier1.sh parses: a silently-skipped
+        # checker family would change this number
+        assert f"families={len(FAMILIES)}" in proc.stderr, proc.stderr
     if "--san" in args:
         assert "dpowsan: clean" in proc.stderr, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# DPOW1001 epoch-fence discipline (tracing.py)
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_fence_fires_on_unguarded_apply_write(tmp_path):
+    """Every frontier-write shape outside an epoch comparison must fire:
+    a set_base call, a dev_bases element store, and an EMA credit."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/apply.py": (
+                "class Engine:\n"
+                "    def apply(self, rec, job, nonce):\n"
+                "        for epoch in rec.dev_epochs:\n"
+                "            job.set_base(nonce + 1)\n"
+                "            job.dev_bases[0] = nonce + 1\n"
+                "            self.device_ema[0] = 1.0\n"
+            )
+        },
+    )
+    found = tracing.check_epoch_fence(project)
+    assert len(found) == 3
+    assert codes(found) == ["DPOW1001"]
+
+
+def test_epoch_fence_quiet_on_guard_and_early_exit_idioms(tmp_path):
+    """Both fence shapes the engine uses are clean: the enclosing
+    ``if epoch == job.dev_epoch:`` guard and the ``!= … continue``
+    early-exit, plus dispatch-path functions (no epoch snapshot read,
+    no epoch parameter) which legitimately advance bases unfenced."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/good.py": (
+                "class Engine:\n"
+                "    def apply(self, rec, job, nonce):\n"
+                "        for row, epoch in enumerate(rec.dev_epochs):\n"
+                "            if epoch == job.dev_epoch:\n"
+                "                job.set_base(nonce + 1)\n\n"
+                "    def apply_early_exit(self, rec, job, nonce):\n"
+                "        for row, epoch in enumerate(rec.dev_epochs):\n"
+                "            if epoch != job.dev_epoch:\n"
+                "                continue\n"
+                "            job.set_base(nonce + 1)\n"
+                "            job.dev_scanned[0] += 1\n\n"
+                "    def attribute(self, job, d, epoch):\n"
+                "        if epoch != job.dev_epoch:\n"
+                "            return\n"
+                "        self.device_ema[d] = 1.0\n\n"
+                "    def dispatch(self, job, span):\n"
+                "        job.set_base(job.base + span)\n"
+            )
+        },
+    )
+    assert tracing.check_epoch_fence(project) == []
+
+
+def _strip_epoch_guards(source: str) -> str:
+    """Delete every ``if <epoch comparison>:`` wrapper, splicing its body
+    into the parent suite — 'deleting the PR-6 guard'."""
+    import ast as _ast
+
+    class Strip(_ast.NodeTransformer):
+        def visit_If(self, node):
+            self.generic_visit(node)
+            if tracing._epoch_compare(node.test):
+                return node.body + node.orelse
+            return node
+
+    tree = Strip().visit(_ast.parse(source))
+    _ast.fix_missing_locations(tree)
+    return _ast.unparse(tree)
+
+
+def test_deleting_the_epoch_guard_from_real_apply_rows_fires(tmp_path):
+    """ISSUE 15 acceptance: a fixture copy of the REAL engine's
+    ``_apply_plain_rows`` is clean as shipped, and deleting the PR-6
+    epoch guard (the ``if epoch == job.dev_epoch:`` around the weak-hit
+    rewind) re-fires DPOW1001 — the stale-epoch frontier-rewind class
+    stays lint-caught even if the runtime tests rot."""
+    import ast as _ast
+
+    real = (REPO_ROOT / "tpu_dpow" / "backend" / "jax_backend.py").read_text(
+        encoding="utf-8"
+    )
+    fn_src = None
+    for node in _ast.walk(_ast.parse(real)):
+        if (
+            isinstance(node, _ast.FunctionDef)
+            and node.name == "_apply_plain_rows"
+        ):
+            fn_src = _ast.get_source_segment(real, node)
+    assert fn_src, "_apply_plain_rows moved — update the acceptance fixture"
+    module = "class Engine:\n" + "\n".join(
+        "    " + line for line in fn_src.splitlines()
+    )
+
+    pristine = tracing.check_epoch_fence(
+        make_project(tmp_path / "pre", {"tpu_dpow/fix.py": module})
+    )
+    assert pristine == [], pristine
+
+    broken = _strip_epoch_guards(module)
+    assert broken != module, "no epoch guard found to delete?"
+    fired = tracing.check_epoch_fence(
+        make_project(tmp_path / "post", {"tpu_dpow/fix.py": broken})
+    )
+    assert any(
+        f.code == "DPOW1001" and "set_base" in f.message for f in fired
+    ), fired
+
+
+# ---------------------------------------------------------------------------
+# DPOW1002 traced-value leakage (tracing.py)
+# ---------------------------------------------------------------------------
+
+
+def test_traced_leak_fires_in_decorated_and_lax_callees(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/traced.py": (
+                "import functools\n"
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "from jax import lax\n\n"
+                "@functools.partial(jax.jit, static_argnames=('n',))\n"
+                "def scan_chunk(params, n):\n"
+                "    found = jnp.any(params > 0)\n"
+                "    if found:\n"
+                "        return params\n"
+                "    assert jnp.all(params == 0)\n"
+                "    return params * 2\n\n"
+                "def run(state0):\n"
+                "    def body(state):\n"
+                "        if state > 3:\n"
+                "            return state - 1\n"
+                "        return state + 1\n"
+                "    def cond(state):\n"
+                "        return bool(state)\n"
+                "    return lax.while_loop(cond, body, state0)\n"
+            )
+        },
+    )
+    found = tracing.check_traced_leak(project)
+    assert codes(found) == ["DPOW1002"]
+    kinds = " | ".join(f.message for f in found)
+    assert "if" in kinds and "assert" in kinds and "bool()" in kinds
+    assert len(found) == 4
+
+
+def test_traced_leak_quiet_on_static_branches_and_where(tmp_path):
+    """Branching on static Python config inside a jitted function, and
+    data-dependent selection through jnp.where/lax.cond, are the
+    sanctioned idioms and must not fire. Untraced helpers may branch
+    freely."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/good.py": (
+                "import functools\n"
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "from jax import lax\n\n"
+                "@functools.partial(jax.jit, static_argnames=('kernel',))\n"
+                "def launch(params, kernel):\n"
+                "    window = 8 * 128\n"
+                "    if window >= 1 << 31:\n"
+                "        raise ValueError('window too large')\n"
+                "    if kernel == 'pallas':\n"
+                "        out = jnp.sum(params)\n"
+                "    else:\n"
+                "        out = jnp.max(params)\n"
+                "    return jnp.where(out > 0, out, -out)\n\n"
+                "def helper(flag):\n"
+                "    if flag:\n"
+                "        return 1\n"
+                "    return 0\n"
+            )
+        },
+    )
+    assert tracing.check_traced_leak(project) == []
+
+
+# ---------------------------------------------------------------------------
+# DPOW1003 recompile/warm-ladder hazard (tracing.py)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_ladder_fires_on_unhashable_and_varying_statics(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/kern.py": (
+                "import functools\n"
+                "import jax\n\n"
+                "@functools.partial(jax.jit, static_argnames=('geom', 'tag'))\n"
+                "def kernel(params, geom, tag):\n"
+                "    return params\n\n"
+                "@functools.lru_cache(maxsize=None)\n"
+                "def compile_factory(devices, span):\n"
+                "    return devices\n"
+            ),
+            "tpu_dpow/calls.py": (
+                "from .kern import kernel, compile_factory\n\n"
+                "def bad(params, request):\n"
+                "    kernel(params, geom=[8, 128], tag=f'req-{request.id}')\n"
+                "    compile_factory([1, 2, 3], 4)\n"
+            ),
+        },
+    )
+    found = tracing.check_warm_ladder(project)
+    assert codes(found) == ["DPOW1003"]
+    msgs = " | ".join(f.message for f in found)
+    assert "non-hashable" in msgs and "f-string" in msgs and "lru_cache" in msgs
+    assert len(found) == 3
+
+
+def test_warm_ladder_fires_on_dispatch_bypassing_warm_set(tmp_path):
+    """The PR-4 soak-flake shape: a dispatch method computing its own
+    step count and launching without consulting _warm/_pick_shape."""
+    bad = (
+        "class Engine:\n"
+        "    def setup(self):\n"
+        "        self._warm = {(1, 1)}\n\n"
+        "    def dispatch(self, params, difficulty):\n"
+        "        steps = self._steps_for(difficulty)\n"
+        "        return self._submit_launch(params, steps)\n"
+    )
+    good = bad.replace(
+        "        steps = self._steps_for(difficulty)\n",
+        "        b, steps = self._pick_shape(1, self._steps_for(difficulty))\n",
+    )
+    fired = tracing.check_warm_ladder(
+        make_project(tmp_path / "pre", {"tpu_dpow/e.py": bad})
+    )
+    assert [f.code for f in fired] == ["DPOW1003"] and fired[0].line == 7
+    assert (
+        tracing.check_warm_ladder(
+            make_project(tmp_path / "post", {"tpu_dpow/e.py": good})
+        )
+        == []
+    )
+
+
+def test_warm_ladder_quiet_on_literal_probe_and_hashable_statics(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/good.py": (
+                "import functools\n"
+                "import jax\n\n"
+                "@functools.partial(jax.jit, static_argnames=('n',))\n"
+                "def kernel(params, n):\n"
+                "    return params\n\n"
+                "def fine(params):\n"
+                "    kernel(params, n=8)\n\n"
+                "class Engine:\n"
+                "    def setup(self):\n"
+                "        self._warm = {(1, 1)}\n\n"
+                "    def probe(self, params):\n"
+                "        return self._submit_launch(params, 1)\n\n"
+                "    def warmup(self, params, steps):\n"
+                "        if (1, steps) in self._warm:\n"
+                "            return None\n"
+                "        return self._timed_launch(params, steps)\n"
+            )
+        },
+    )
+    assert tracing.check_warm_ladder(project) == []
+
+
+# ---------------------------------------------------------------------------
+# DPOW1004 slot/launch lifetime (tracing.py)
+# ---------------------------------------------------------------------------
+
+
+def test_slot_lifetime_fires_on_loose_release_and_fut_liveness(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/bad.py": (
+                "from ..ops import control as ctl\n\n"
+                "class Engine:\n"
+                "    def eject(self, rec):\n"
+                "        ctl.release(rec.slot)\n\n"
+                "    def sweep(self, recs):\n"
+                "        return [r for r in recs if not r.fut.done()]\n"
+            )
+        },
+    )
+    found = tracing.check_slot_lifetime(project)
+    assert len(found) == 2 and codes(found) == ["DPOW1004"]
+    msgs = " | ".join(f.message for f in found)
+    assert "finally" in msgs and "thread_done" in msgs
+
+
+def test_slot_lifetime_quiet_on_finally_and_thread_done_fallback(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/good.py": (
+                "from ..ops import control as ctl\n\n"
+                "class Engine:\n"
+                "    def launch(self, slot):\n"
+                "        try:\n"
+                "            return self._run()\n"
+                "        finally:\n"
+                "            ctl.release(slot)\n\n"
+                "    def returned(self, rec):\n"
+                "        if rec.thread_done is not None:\n"
+                "            return rec.thread_done.is_set()\n"
+                "        return rec.fut.done()\n\n"
+                "    def lock_release_is_not_a_slot(self):\n"
+                "        self._lock.release()\n"
+            ),
+            # the slot table's own module manages its entries freely
+            "tpu_dpow/ops/control.py": (
+                "def release(slot):\n"
+                "    _slots.pop(slot, None)\n\n"
+                "def expire(slot):\n"
+                "    release(slot)\n"
+            ),
+        },
+    )
+    assert tracing.check_slot_lifetime(project) == []
+
+
+# ---------------------------------------------------------------------------
+# DPOW1005 store atomicity (atomicity.py)
+# ---------------------------------------------------------------------------
+
+
+def test_store_atomicity_fires_on_rmw_direct_and_via_helper(tmp_path):
+    """The quota-ledger shape: a read through a same-class helper (class
+    constant prefix) followed by a plain hset, and a direct get→set RMW
+    on a module-constant key."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/rmw.py": (
+                "COUNT_KEY = 'fleet:worker:count'\n\n"
+                "class Ledger:\n"
+                "    PREFIX = 'quota:'\n\n"
+                "    async def _load(self, service):\n"
+                "        return await self.store.hgetall("
+                "f'{self.PREFIX}{service}')\n\n"
+                "    async def consume(self, service):\n"
+                "        state = await self._load(service)\n"
+                "        await self.store.hset(f'{self.PREFIX}{service}', "
+                "state)\n\n"
+                "async def bump(store):\n"
+                "    n = int(await store.get(COUNT_KEY) or 0)\n"
+                "    await store.set(COUNT_KEY, str(n + 1))\n"
+            )
+        },
+    )
+    found = atomicity.check(project)
+    assert len(found) == 2 and codes(found) == ["DPOW1005"]
+    prefixes = " | ".join(f.message for f in found)
+    assert "quota:" in prefixes and "fleet:" in prefixes
+
+
+def test_store_atomicity_quiet_on_primitives_fence_and_foreign_keys(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            # atomic primitives ARE the fix; reads alone never fire;
+            # unrelated prefixes are not shared spaces
+            "tpu_dpow/good.py": (
+                "async def bump(store):\n"
+                "    await store.get('fleet:worker:count')\n"
+                "    await store.incrby('fleet:worker:count')\n"
+                "    await store.setnx('quota:svc', '1')\n\n"
+                "async def unrelated(store):\n"
+                "    v = await store.get('block:abc')\n"
+                "    await store.set('block:abc', v)\n\n"
+                "async def cross_prefix(store):\n"
+                "    await store.get('quota:svc')\n"
+                "    await store.set('fleet:worker:x', '1')\n"
+            ),
+            # fence.py is the sanctioned fenced-RMW boundary
+            "tpu_dpow/replica/fence.py": (
+                "async def adopt(store, rid):\n"
+                "    rec = await store.hgetall(f'replica:member:{rid}')\n"
+                "    await store.hset(f'replica:member:{rid}', rec)\n"
+            ),
+        },
+    )
+    assert atomicity.check(project) == []
+
+
+def test_store_atomicity_real_quota_waiver_is_load_bearing():
+    """The shipped QuotaLedger waiver must stay honest: stripping the
+    inline waiver from a pristine copy of sched/quota.py re-fires
+    DPOW1005 (the documented last-writer-wins contract is a waived
+    finding, not a blind spot)."""
+    real = (REPO_ROOT / "tpu_dpow" / "sched" / "quota.py").read_text(
+        encoding="utf-8"
+    )
+    stripped = "\n".join(
+        line for line in real.splitlines() if "dpowlint: disable" not in line
+    )
+    assert stripped != real, "quota.py lost its DPOW1005 waiver?"
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        project = make_project(
+            Path(d), {"tpu_dpow/sched/quota.py": stripped}
+        )
+        found = atomicity.check(project)
+    assert [f.code for f in found] == ["DPOW1005"], found
+    assert "quota:" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# DPOW002 stale-waiver enforcement
+# ---------------------------------------------------------------------------
+
+
+def test_stale_waiver_fires_and_consuming_waiver_does_not(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/w.py": (
+                "import time\n\n"
+                "def stamps():\n"
+                "    a = time.time()  # dpowlint: disable=DPOW101 — consumed\n"
+                "    b = 2  # dpowlint: disable=DPOW101 — stale: suppresses nothing\n"
+                "    return a, b\n"
+            )
+        },
+    )
+    found = run_all(project, [clock.check])
+    assert codes(found) == ["DPOW002"]
+    assert len(found) == 1 and found[0].line == 5
+    assert "stale waiver" in found[0].message
+
+
+def test_stale_waiver_escape_hatch_for_preventive_waivers(tmp_path):
+    """`disable=CODE,DPOW002` marks a deliberately-preventive waiver:
+    the DPOW002 co-waiver suppresses the staleness finding, and is never
+    itself judged stale (no second-order fixpoint)."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/w.py": (
+                "def quiet():\n"
+                "    # dpowlint: disable=DPOW101,DPOW002 — preventive: guards a planned hot path\n"
+                "    return 2\n"
+            )
+        },
+    )
+    assert run_all(project, [clock.check]) == []
+
+
+def test_stale_waiver_all_escape_still_accounted(tmp_path):
+    """A blanket ALL waiver is consumed when anything was suppressed and
+    stale when nothing was."""
+    files = {
+        "tpu_dpow/used.py": (
+            "import time\n\n"
+            "def stamp():\n"
+            "    return time.time()  # dpowlint: disable=ALL — blanket\n"
+        ),
+        "tpu_dpow/unused.py": (
+            "def nothing():\n"
+            "    return 1  # dpowlint: disable=ALL — suppresses nothing\n"
+        ),
+    }
+    project = make_project(tmp_path, files)
+    found = run_all(project, [clock.check])
+    assert codes(found) == ["DPOW002"]
+    assert [f.path for f in found] == ["tpu_dpow/unused.py"]
+
+
+def test_every_shipped_waiver_is_load_bearing():
+    """The tree-wide burn-down contract: DPOW002 stays clean on the real
+    repo — every inline waiver in the package suppresses at least one
+    live finding (run via test_repo_is_clean_against_committed_baseline,
+    re-asserted here against the meta-code specifically)."""
+    stale = [
+        f
+        for f in run_all(Project(REPO_ROOT), CHECKERS)
+        if f.code == "DPOW002"
+    ]
+    assert stale == [], "\n".join(f.render() for f in stale)
+
+
+# ---------------------------------------------------------------------------
+# family registry + runtime budget + CLI modes (ISSUE 15 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_family_registry_covers_every_catalogue_code():
+    """FAMILIES is the headline denominator: every code any checker can
+    emit must belong to exactly one family, and the registry must be
+    DERIVED from the registered checker modules — dropping a module from
+    the registration tuple must change the families=N count, or the
+    headline's 'a silently-skipped family is visible' claim is false."""
+    import sys as _sys
+
+    all_codes = [c for _name, cs in FAMILIES for c in cs]
+    assert len(all_codes) == len(set(all_codes)), "code in two families"
+    assert set(all_codes) | {"ALL"} == set(KNOWN_CODES)
+    # one family per new ISSUE 15 checker, all registered
+    assert {"DPOW1001", "DPOW1002", "DPOW1003", "DPOW1004", "DPOW1005",
+            "DPOW002"} <= set(all_codes)
+    assert tracing.check in CHECKERS and atomicity.check in CHECKERS
+    assert len(FAMILIES) == 16
+    # derivation: FAMILIES is exactly the meta-family plus each
+    # registered checker's own module declaration, in registration order
+    derived = [("stale-waiver", ("DPOW002",))]
+    for check in CHECKERS:
+        derived.extend(_sys.modules[check.__module__].FAMILIES)
+    assert list(FAMILIES) == derived
+
+
+def test_full_repo_analysis_stays_inside_the_runtime_budget():
+    """ISSUE 15 satellite: with the DPOW10xx families aboard, the full
+    static pass must stay cheap enough to sit in every lint invocation.
+    Budget: ~2x the measured PR-8-era wall time (~1.1 s on this box)
+    plus slack for loaded CI — the single-parse SourceFile cache and the
+    text-level file gates are what keep this bounded."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    run_all(Project(REPO_ROOT), CHECKERS)
+    elapsed = _time.perf_counter() - t0
+    assert elapsed < 8.0, f"full dpowlint pass took {elapsed:.2f}s"
+
+
+def test_cli_json_output_is_machine_readable(tmp_path):
+    """--json: the findings array, counts, and family denominator parse
+    back; exit code semantics unchanged."""
+    import json as _json
+
+    bad = tmp_path / "proj"
+    (bad / "tpu_dpow").mkdir(parents=True)
+    (bad / "tpu_dpow" / "bad.py").write_text(
+        "import time\n\ndef stamp():\n    return time.time()\n",
+        encoding="utf-8",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_dpow.analysis",
+            "--root", str(bad), "--json",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = _json.loads(proc.stdout)
+    assert payload["families"] == len(FAMILIES)
+    assert payload["changed_only"] is False
+    assert [f["code"] for f in payload["findings"]] == ["DPOW101"]
+    f = payload["findings"][0]
+    assert f["path"] == "tpu_dpow/bad.py" and f["line"] == 4
+
+    # clean root: empty array, exit 0
+    good = tmp_path / "clean"
+    (good / "tpu_dpow").mkdir(parents=True)
+    (good / "tpu_dpow" / "ok.py").write_text("X = 1\n", encoding="utf-8")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_dpow.analysis",
+            "--root", str(good), "--json",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert _json.loads(proc.stdout)["findings"] == []
+
+
+def test_cli_changed_only_scopes_to_the_git_diff(tmp_path):
+    """--changed_only: a finding in a file the working tree changed is
+    reported; the same finding committed-and-untouched is not; outside a
+    git repo nothing is reported (and the exit goes clean)."""
+    bad_src = "import time\n\ndef stamp():\n    return time.time()\n"
+    repo = tmp_path / "proj"
+    (repo / "tpu_dpow").mkdir(parents=True)
+    (repo / "tpu_dpow" / "legacy.py").write_text(bad_src, encoding="utf-8")
+    (repo / "tpu_dpow" / "fresh.py").write_text("X = 1\n", encoding="utf-8")
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=repo, check=True, capture_output=True,
+            env={
+                **os.environ,
+                "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+            },
+        )
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # now introduce the same defect in the CHANGED file only
+    (repo / "tpu_dpow" / "fresh.py").write_text(bad_src, encoding="utf-8")
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_dpow.analysis",
+            "--root", str(repo), "--changed_only",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "tpu_dpow/fresh.py" in proc.stdout
+    assert "legacy.py" not in proc.stdout  # committed+untouched: scoped out
+    assert "(changed files only)" in proc.stderr
+    # the scoped-out legacy finding is live un-baselined debt, and must
+    # never be reported as parked in baseline.txt
+    assert "baselined" not in proc.stderr
+
+    # editing the checkers themselves widens to the full report: their
+    # findings anchor in unchanged files by construction
+    (repo / "tpu_dpow" / "analysis").mkdir()
+    (repo / "tpu_dpow" / "analysis" / "new_checker.py").write_text(
+        "X = 1\n", encoding="utf-8"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_dpow.analysis",
+            "--root", str(repo), "--changed_only",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "widened to the full report" in proc.stderr
+    assert "legacy.py" in proc.stdout  # unchanged file now reported
+    import shutil as _sh
+
+    _sh.rmtree(repo / "tpu_dpow" / "analysis")
+
+    # no git metadata at the root ⇒ fail CLOSED: full report + warning,
+    # never a silent clean (a git failure must not read as a clean tree)
+    import shutil
+
+    shutil.rmtree(repo / ".git")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_dpow.analysis",
+            "--root", str(repo), "--changed_only",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "falling back to the full report" in proc.stderr
+    assert "legacy.py" in proc.stdout and "fresh.py" in proc.stdout
+    assert "(changed files only)" not in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions: pruned + ordered traversal
+# ---------------------------------------------------------------------------
+
+
+def test_traced_leak_prunes_nested_host_callbacks(tmp_path):
+    """A nested (untraced) host callback whose parameter shadows a name
+    the enclosing jit function tainted must NOT fire — nested defs are
+    judged on their own merits, not under the parent's taint set."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/cb.py": (
+                "import functools\n"
+                "import jax\n"
+                "import jax.numpy as jnp\n\n"
+                "@functools.partial(jax.jit, static_argnames=('n',))\n"
+                "def launch(params, n):\n"
+                "    s = jnp.sum(params)\n"
+                "    def host_side(s):\n"
+                "        if s:\n"
+                "            return 1\n"
+                "        return 0\n"
+                "    return s\n"
+            )
+        },
+    )
+    assert tracing.check_traced_leak(project) == []
+
+
+def test_traced_leak_taint_survives_block_nesting(tmp_path):
+    """Taint must propagate in SOURCE order across block boundaries: an
+    assignment inside a with/for block followed by a function-level
+    branch is exactly the leak class — a breadth-first walk visits the
+    shallow If before the deep Assign and misses it."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/deep.py": (
+                "import functools\n"
+                "import jax\n"
+                "import jax.numpy as jnp\n\n"
+                "@functools.partial(jax.jit, static_argnames=())\n"
+                "def launch(params):\n"
+                "    with jax.named_scope('scan'):\n"
+                "        y = jnp.sum(params)\n"
+                "    if y > 0:\n"
+                "        return y\n"
+                "    return -y\n"
+            )
+        },
+    )
+    found = tracing.check_traced_leak(project)
+    assert [f.code for f in found] == ["DPOW1002"], found
+    assert found[0].line == 9
+
+
+def test_store_atomicity_prunes_nested_callback_reads(tmp_path):
+    """A read that only happens inside a nested callback must not pair
+    with the enclosing function's write into a phantom RMW."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/cb.py": (
+                "async def setup(store, bus):\n"
+                "    async def on_tick():\n"
+                "        return await store.get('quota:svc')\n"
+                "    bus.subscribe(on_tick)\n"
+                "    await store.set('quota:init', '1')\n"
+            )
+        },
+    )
+    assert atomicity.check(project) == []
+
+
+def test_stale_waiver_judged_only_for_checkers_that_ran(tmp_path):
+    """A DPOW801 waiver must NOT be called stale by a run that never
+    executed the concurrency checker — staleness is scoped to the codes
+    the executed checkers can emit. Unknown-code judgments still apply
+    (DPOW999 can never be emitted by anything)."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/w.py": (
+                "def later():\n"
+                "    # dpowlint: disable=DPOW801 — guards a real race the full run sees\n"
+                "    return 1\n"
+            )
+        },
+    )
+    assert run_all(project, [clock.check]) == []
+    # the full registry DOES judge it (nothing here fires DPOW801)
+    full = run_all(project, CHECKERS)
+    assert codes(full) == ["DPOW002"]
+
+
+def test_traced_leak_taints_through_annassign_and_augassign(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/ann.py": (
+                "import functools\n"
+                "import jax\n"
+                "import jax.numpy as jnp\n\n"
+                "@functools.partial(jax.jit, static_argnames=())\n"
+                "def launch(params):\n"
+                "    found: jnp.ndarray = jnp.any(params > 0)\n"
+                "    if found:\n"
+                "        return params\n"
+                "    acc = 0\n"
+                "    acc += jnp.sum(params)\n"
+                "    while acc > 0:\n"
+                "        acc = acc - 1\n"
+                "    return acc\n"
+            )
+        },
+    )
+    found = tracing.check_traced_leak(project)
+    assert codes(found) == ["DPOW1002"]
+    assert sorted(f.line for f in found) == [8, 12]

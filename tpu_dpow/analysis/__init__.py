@@ -1,7 +1,7 @@
 """dpowlint: AST-based invariant checkers for this repo's own contracts.
 
-Four subsystems (obs, resilience, sched, fleet) rest on project-wide
-conventions that nothing enforced mechanically until now:
+Five subsystems (obs, resilience, sched, fleet, the jax engine) rest on
+project-wide conventions that nothing enforced mechanically until now:
 
   * every timer must run on the injectable ``resilience.Clock`` — a stray
     ``time.time()`` silently exempts its code path from every FakeClock
@@ -13,16 +13,33 @@ conventions that nothing enforced mechanically until now:
     held ``threading.Lock`` (DPOW401);
   * the ``dpow_*`` metric catalogue, the MQTT topic grammar + ACL matrix,
     and the ``--flag`` tables in docs/ must match the code (DPOW5xx/6xx/7xx)
-    — PR 4 had to hand-extend ACLs, which is the bug class these close.
+    — PR 4 had to hand-extend ACLs, which is the bug class these close;
+  * the jax engine's machine-specific discipline — epoch-fenced frontier
+    writes, no Python branching on traced values, warm-ladder-derived
+    launch shapes, thread-scoped control-slot lifetime (DPOW10xx,
+    analysis/tracing.py) and no load-then-save RMW on shared store keys
+    (DPOW1005, analysis/atomicity.py) — is exactly what generic linters
+    cannot see;
+  * an inline waiver that suppresses nothing is itself a finding
+    (DPOW002): stale justifications read as live contracts in review.
 
 Stdlib only (ast + tokenize): the build image has no ruff, and the checks
 are project-specific anyway. Run as ``python -m tpu_dpow.analysis``; wired
-into scripts/lint.sh and tier-1 via tests/test_analysis.py. Catalogue and
-waiver syntax: docs/analysis.md.
+into scripts/lint.sh (``--changed_only`` there for fast iteration) and
+tier-1 via tests/test_analysis.py + the ``DPOWLINT=… families=N``
+headline in scripts/run_tier1.sh. Catalogue and waiver syntax:
+docs/analysis.md.
 """
 
-from .core import Baseline, Finding, Project, run_all  # noqa: F401
+from .core import (  # noqa: F401
+    CODE_STALE_WAIVER,
+    Baseline,
+    Finding,
+    Project,
+    run_all,
+)
 from . import (  # noqa: F401
+    atomicity,
     blocking,
     clock,
     concurrency,
@@ -32,17 +49,41 @@ from . import (  # noqa: F401
     replica_keys,
     tasks,
     topics,
+    tracing,
 )
 
-#: checker registry, in catalogue order (docs/analysis.md)
-CHECKERS = (
-    clock.check,
-    blocking.check,
-    tasks.check,
-    locks.check,
-    metrics.check,
-    topics.check,
-    flags.check,
-    concurrency.check,
-    replica_keys.check,
+#: checker modules, in catalogue order (docs/analysis.md) — the single
+#: registration point: CHECKERS, FAMILIES and KNOWN_CODES all derive
+#: from this tuple, so dropping a module here (or losing one in a merge)
+#: changes the ``families=N`` headline instead of leaving an invisible
+#: gap.
+_CHECKER_MODULES = (
+    clock,
+    blocking,
+    tasks,
+    locks,
+    metrics,
+    topics,
+    flags,
+    concurrency,
+    replica_keys,
+    tracing,
+    atomicity,
 )
+
+#: checker registry (one ``check(project)`` per module)
+CHECKERS = tuple(m.check for m in _CHECKER_MODULES)
+
+#: checker families and the codes each can emit, DERIVED from the
+#: registered modules' own FAMILIES declarations. This is the headline
+#: denominator (``DPOWLINT=clean families=N`` in run_tier1.sh). The
+#: DPOW002 meta-family is emitted by core.run_all itself and always
+#: present.
+FAMILIES = (("stale-waiver", (CODE_STALE_WAIVER,)),) + tuple(
+    entry for m in _CHECKER_MODULES for entry in m.FAMILIES
+)
+
+#: every code a registered checker (or the meta-pass) can emit; the
+#: DPOW002 unknown-code judgment is made against this set, and "ALL" is
+#: the documented waive-everything escape hatch.
+KNOWN_CODES = frozenset(c for _name, cs in FAMILIES for c in cs) | {"ALL"}

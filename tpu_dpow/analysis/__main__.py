@@ -1,25 +1,36 @@
-"""CLI: ``python -m tpu_dpow.analysis [--root DIR] [--write-baseline] [--san]``.
+"""CLI: ``python -m tpu_dpow.analysis [--root DIR] [--write-baseline]
+[--json] [--changed_only] [--san]``.
 
 Exit 0 when every finding is inline-waived or baselined, 1 otherwise.
-Output format (one per line): ``path:line  CODE  message``. ``--san``
-additionally replays the sanitizer scenarios (analysis/sanitizer.py)
-under ``--san_seeds`` seeded interleavings and fails on any scenario
-invariant breach. The run prints its own wall time: the whole static
-pass must stay cheap enough to sit in every lint invocation (one parsed
-AST per file, shared across all checker families — core.SourceFile).
+Output format (one per line): ``path:line  CODE  message``; ``--json``
+emits the same findings as a machine-readable array on stdout instead.
+``--changed_only`` scopes the REPORT to files the git working tree
+changed against HEAD (full parse either way — the contract checkers are
+whole-repo by nature): scripts/lint.sh uses it for fast iteration while
+run_tier1.sh keeps the full run. ``--san`` additionally replays the
+sanitizer scenarios (analysis/sanitizer.py) under ``--san_seeds`` seeded
+interleavings and fails on any scenario invariant breach. The run prints
+its own wall time and its active family count (``families=N`` — a
+silently-skipped checker family is a changed N, not an invisible gap):
+the whole static pass must stay cheap enough to sit in every lint
+invocation (one parsed AST per file, shared across all checker families
+— core.SourceFile).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 import time
 from pathlib import Path
 
-from . import CHECKERS, sanitizer
+from . import CHECKERS, FAMILIES, sanitizer
 from .core import DEFAULT_BASELINE, Baseline, Project, run_all
 
 _CATALOGUE = """\
+DPOW002  stale-waiver        inline waiver suppresses zero findings / names an unknown code
 DPOW101  clock-discipline    timers must ride the injectable resilience.Clock
 DPOW201  async-blocking      no blocking calls lexically inside async def
 DPOW301  task-leak           create_task/ensure_future results must be retained
@@ -41,20 +52,53 @@ DPOW801  await-interference  shared state checked, then mutated after an await
 DPOW802  lock-order          acquisition cycles / reentrant lock acquisition
 DPOW803  untrusted-input     raw transport payload consumed before the decode boundary
 DPOW901  replica-key-fence   replica:* store write outside replica/fence.py (unfenced)
+DPOW1001 epoch-fence         apply-path frontier write with no dominating epoch comparison
+DPOW1002 traced-leak         Python if/while/assert/bool() on a jax-traced value
+DPOW1003 warm-ladder         unhashable/varying jit static args; launch shapes bypassing _warm
+DPOW1004 slot-lifetime       control-slot release outside the thread's finally; fut-based liveness
+DPOW1005 store-atomicity     load-then-save RMW on shared replica:/quota:/fleet: keys
 
 Waive inline with `# dpowlint: disable=CODE — justification` (applies to
 that line and the next); park intentional debt in the baseline file.
-The DPOW801 family has a runtime confirmer: --san replays the coalescing
-and fleet re-cover scenarios under seeded interleaving perturbation
-(--san_seeds N, env DPOW_SAN_SEEDS). Details: docs/analysis.md."""
+A waiver that suppresses nothing is itself a finding (DPOW002).
+The DPOW801/1001 families have a runtime confirmer: --san replays the
+coalescing, fleet re-cover, takeover, device-fault and autoscale-drain
+scenarios under seeded interleaving perturbation (--san_seeds N, env
+DPOW_SAN_SEEDS). Details: docs/analysis.md."""
+
+
+def _changed_paths(root: Path):
+    """Root-relative paths the working tree changed against HEAD (staged
+    + unstaged + untracked) — the --changed_only report scope.
+    ``--relative`` keeps diff paths root-relative even when root sits
+    below the git toplevel (ls-files is cwd-relative already). Returns
+    None when git itself fails (missing/hung/not a repo): the caller
+    must fall back to the FULL report — a git failure must never read
+    as a clean tree."""
+    out = set()
+    for args in (
+        ["git", "diff", "--relative", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(p.strip() for p in proc.stdout.splitlines() if p.strip())
+    return out
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         "python -m tpu_dpow.analysis",
         description="dpowlint: AST-based invariant checkers for the "
-        "async/Clock/metrics/topic/flag/concurrency contracts "
-        "(docs/analysis.md), plus the dpowsan interleaving sanitizer",
+        "async/Clock/metrics/topic/flag/concurrency/engine-discipline "
+        "contracts (docs/analysis.md), plus the dpowsan interleaving "
+        "sanitizer",
     )
     parser.add_argument(
         "--root",
@@ -75,6 +119,19 @@ def main(argv=None) -> int:
         "--no-baseline",
         action="store_true",
         help="report baselined findings too (the full debt view)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output: a JSON object with the fresh "
+        "findings, counts and timing on stdout (exit code unchanged)",
+    )
+    parser.add_argument(
+        "--changed_only",
+        action="store_true",
+        help="report only findings in files the git working tree changed "
+        "against HEAD (full parse — contract checkers are whole-repo); "
+        "if git itself fails, falls back to the full report",
     )
     parser.add_argument(
         "--list", action="store_true", help="print the checker catalogue"
@@ -105,15 +162,65 @@ def main(argv=None) -> int:
 
     baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
     fresh = [f for f in findings if not baseline.covers(f)]
-    for f in fresh:
-        print(f.render())
+    # baselined is counted BEFORE any report scoping: a fresh finding a
+    # --changed_only run scopes out is still live un-baselined debt, and
+    # must never be reported as parked in baseline.txt.
     baselined = len(findings) - len(fresh)
+    changed_scope = args.changed_only
+    if args.changed_only:
+        changed = _changed_paths(root)
+        if changed is None:
+            print(
+                "dpowlint: git unavailable for --changed_only — "
+                "falling back to the full report",
+                file=sys.stderr,
+            )
+            changed_scope = False
+        elif any(p.startswith("tpu_dpow/analysis/") for p in changed):
+            # The checkers themselves changed: their new findings anchor
+            # in UNCHANGED files by construction (analysis/ is excluded
+            # from its own scan), so a scoped report would always read
+            # clean — run the full report instead.
+            print(
+                "dpowlint: analysis/ itself changed — --changed_only "
+                "widened to the full report",
+                file=sys.stderr,
+            )
+            changed_scope = False
+        else:
+            fresh = [f for f in fresh if f.path in changed]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "path": f.path,
+                            "line": f.line,
+                            "code": f.code,
+                            "message": f.message,
+                        }
+                        for f in fresh
+                    ],
+                    "baselined": baselined,
+                    "families": len(FAMILIES),
+                    "changed_only": changed_scope,
+                    "elapsed_s": round(static_elapsed, 3),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in fresh:
+            print(f.render())
+    scope = " (changed files only)" if changed_scope else ""
     rc = 0
     if fresh:
         print(
             f"dpowlint: {len(fresh)} finding(s)"
             + (f" ({baselined} baselined)" if baselined else "")
-            + f" in {static_elapsed:.2f}s",
+            + f"{scope} in {static_elapsed:.2f}s"
+            + f" families={len(FAMILIES)}",
             file=sys.stderr,
         )
         rc = 1
@@ -121,7 +228,8 @@ def main(argv=None) -> int:
         print(
             "dpowlint: clean"
             + (f" ({baselined} baselined finding(s) remain)" if baselined else "")
-            + f" in {static_elapsed:.2f}s",
+            + f"{scope} in {static_elapsed:.2f}s"
+            + f" families={len(FAMILIES)}",
             file=sys.stderr,
         )
 

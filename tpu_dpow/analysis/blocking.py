@@ -19,6 +19,10 @@ from typing import List
 
 from .core import Finding, Project, dotted_name, import_aliases, resolve_call
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("async-blocking", ("DPOW201",)),)
+
+
 CODE = "DPOW201"
 
 _BLOCKING_CALLS = {

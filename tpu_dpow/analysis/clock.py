@@ -17,6 +17,10 @@ from typing import List
 
 from .core import Finding, Project, dotted_name, import_aliases, resolve_call
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("clock-discipline", ("DPOW101",)),)
+
+
 CODE = "DPOW101"
 
 #: path-prefix allowlist (project-root-relative) with the justification the

@@ -68,6 +68,10 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .core import Finding, Project, dotted_name, resolve_call
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("concurrency", ("DPOW801", "DPOW802", "DPOW803")),)
+
+
 CODE_INTERFERENCE = "DPOW801"
 CODE_LOCK_ORDER = "DPOW802"
 CODE_TAINT = "DPOW803"

@@ -11,6 +11,7 @@ from __future__ import annotations
 import ast
 import io
 import re
+import sys
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -230,20 +231,116 @@ class Baseline:
 
 DEFAULT_BASELINE = "baseline.txt"  # sibling of this module
 
+#: meta-code: an inline waiver that suppresses zero findings, or names a
+#: code no registered checker can emit, is itself a finding — stale
+#: waivers read as active contracts in review and hide real regressions
+#: behind the day the checker (or the code beneath it) changed.
+CODE_STALE_WAIVER = "DPOW002"
 
-def run_all(project: Project, checkers=None) -> List[Finding]:
-    """Every checker over the project; inline-waived findings removed,
-    baseline NOT applied (that is the CLI's job)."""
+
+def _consume_waiver(src: SourceFile, finding: Finding, consumed: Dict) -> bool:
+    """``src.waived`` with bookkeeping: record WHICH waiver line and code
+    suppressed the finding, so run_all can flag the ones that earn
+    nothing. Mirrors the waived() line/line-above rule exactly."""
+    for ln in (finding.line, finding.line - 1):
+        codes = src.waivers.get(ln, ())
+        if finding.code in codes:
+            consumed.setdefault((src.rel, ln), set()).add(finding.code)
+            return True
+        if "ALL" in codes:
+            consumed.setdefault((src.rel, ln), set()).add("ALL")
+            return True
+    return False
+
+
+def _stale_waiver_findings(
+    project: Project, consumed: Dict, known_codes, emittable
+) -> List[Finding]:
+    """DPOW002 for every waiver entry that suppressed nothing or names an
+    unknown code. Staleness ('suppresses zero findings') is judged ONLY
+    for codes in ``emittable`` — the codes the checkers that actually ran
+    can produce: a DPOW801 waiver is not stale just because a caller ran
+    the clock checker alone. DPOW002 itself may appear in a waiver list
+    as an escape hatch for deliberately-preventive waivers and is never
+    judged stale (no fixpoint: second-order staleness is not a thing)."""
+    out: List[Finding] = []
+    for src in project.sources():
+        for ln in sorted(src.waivers):
+            earned = consumed.get((src.rel, ln), set())
+            for code in sorted(src.waivers[ln]):
+                if code == CODE_STALE_WAIVER:
+                    continue
+                if code == "ALL" and not emittable:
+                    continue
+                if code not in known_codes:
+                    out.append(
+                        Finding(
+                            src.rel,
+                            ln,
+                            CODE_STALE_WAIVER,
+                            f"waiver names unknown code '{code}': no "
+                            "registered checker can emit it, so it "
+                            "suppresses nothing — fix the code name or "
+                            "delete the waiver",
+                        )
+                    )
+                elif code != "ALL" and code not in emittable:
+                    continue  # its checker did not run: no staleness verdict
+                elif code not in earned:
+                    out.append(
+                        Finding(
+                            src.rel,
+                            ln,
+                            CODE_STALE_WAIVER,
+                            f"stale waiver: 'disable={code}' suppresses "
+                            "zero findings on this line — the hazard it "
+                            "documented is gone (or moved); delete the "
+                            "waiver so the justification stops reading "
+                            "as a live contract",
+                        )
+                    )
+    return out
+
+
+def run_all(project: Project, checkers=None, known_codes=None) -> List[Finding]:
+    """Every checker over the project; inline-waived findings removed
+    (each suppression is ACCOUNTED: a waiver that earns nothing, or names
+    an unknown code, surfaces as DPOW002), baseline NOT applied (that is
+    the CLI's job)."""
     if checkers is None:
         from . import CHECKERS
 
         checkers = CHECKERS
+    if known_codes is None:
+        from . import KNOWN_CODES
+
+        known_codes = KNOWN_CODES
+    # the codes the checkers that will actually RUN can emit — staleness
+    # judgments are scoped to these (derived from each check function's
+    # module FAMILIES declaration; an unknown custom checker contributes
+    # nothing and therefore never triggers a staleness verdict).
+    emittable: Set[str] = set()
+    for check in checkers:
+        mod = sys.modules.get(getattr(check, "__module__", ""))
+        for _name, cs in getattr(mod, "FAMILIES", ()):
+            emittable.update(cs)
     by_rel = {s.rel: s for s in project.sources(include_excluded=True)}
+    consumed: Dict[Tuple[str, int], Set[str]] = {}
     out: List[Finding] = []
     for check in checkers:
         for f in check(project):
             src = by_rel.get(f.path)
-            if src is not None and src.waived(f.code, f.line):
+            if src is not None and _consume_waiver(src, f, consumed):
                 continue
             out.append(f)
+    for f in _stale_waiver_findings(project, consumed, known_codes, emittable):
+        src = by_rel.get(f.path)
+        # Only an EXPLICIT DPOW002 co-waiver may silence the meta-pass —
+        # a blanket ALL must not suppress its own staleness finding.
+        if src is not None and any(
+            CODE_STALE_WAIVER in src.waivers.get(ln, ())
+            for ln in (f.line, f.line - 1)
+        ):
+            continue
+        out.append(f)
     return sorted(out, key=lambda f: (f.path, f.line, f.code, f.message))

@@ -24,6 +24,10 @@ from typing import Dict, List, Optional, Tuple
 
 from .core import Finding, Project
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("flag-drift", ("DPOW701", "DPOW702", "DPOW703")),)
+
+
 FLAGS_DOC = "flags.md"
 
 #: (section keyword in the docs header, config path under the package dir)

@@ -19,6 +19,10 @@ from typing import List
 
 from .core import Finding, Project, dotted_name
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("lock-across-await", ("DPOW401",)),)
+
+
 CODE = "DPOW401"
 
 

@@ -24,6 +24,10 @@ from typing import Dict, List, Optional, Tuple
 
 from .core import Finding, Project
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("metrics-contract", ("DPOW501", "DPOW502", "DPOW503", "DPOW504")),)
+
+
 #: catalogue locations, project docs_dir-relative
 DOC_FILES = (
     "observability.md",

@@ -28,6 +28,10 @@ from typing import Dict, List, Optional
 
 from .core import Finding, Project, resolve_call
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("replica-key-fence", ("DPOW901",)),)
+
+
 #: the single module allowed to write replica:* keys (package-dir-relative)
 FENCE_MODULE = "replica/fence.py"
 

@@ -976,6 +976,13 @@ NOT_REPRODUCED = "not-reproduced"
 UNEXERCISED = "unexercised"
 
 
+#: the static race classes dpowsan's scenarios can exercise: DPOW801
+#: check-then-act candidates and DPOW1001 epoch-fence candidates (the
+#: device-fault and takeover scenarios drive exactly the stale-epoch
+#: apply paths the fence checker reasons about).
+ANNOTATED_CODES = ("DPOW801", "DPOW1001")
+
+
 def annotate(findings, report: SanitizerReport) -> Dict[str, str]:
     """Finding.key() → confirmed / not-reproduced / unexercised.
 
@@ -990,7 +997,7 @@ def annotate(findings, report: SanitizerReport) -> Dict[str, str]:
         failing_paths.update(run.tb_paths)
     out: Dict[str, str] = {}
     for finding in findings:
-        if finding.code != "DPOW801":
+        if finding.code not in ANNOTATED_CODES:
             continue
         if finding.path in failing_paths:
             out[finding.key()] = CONFIRMED

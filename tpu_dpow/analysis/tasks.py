@@ -14,6 +14,10 @@ from typing import List
 
 from .core import Finding, Project, import_aliases, resolve_call
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (("task-leak", ("DPOW301",)),)
+
+
 CODE = "DPOW301"
 
 _SPAWNERS = {"asyncio.create_task", "asyncio.ensure_future"}

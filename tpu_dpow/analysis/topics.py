@@ -37,6 +37,13 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .core import Finding, Project
 
+#: checker families this module contributes (aggregated into the registry in __init__.py)
+FAMILIES = (
+    ("topic-contract", ("DPOW601", "DPOW602", "DPOW603", "DPOW604")),
+    ("payload-grammar", ("DPOW605", "DPOW606")),
+)
+
+
 SPEC_DOC = "specification.md"
 ROOTS = ("work", "result", "cancel", "client", "fleet", "replica")
 BARE_TOPICS = {"heartbeat", "statistics"}

@@ -1,0 +1,696 @@
+"""DPOW1001-1004 — JAX engine-discipline checkers.
+
+The three costliest bug classes of PRs 6-12 — stale-epoch frontier
+rewinds, control-slot release racing a still-running launch thread, and
+unwarmed-shape compiles landing on the dispatch path — were each caught
+only by runtime choreography after shipping, yet all three are lexically
+recognizable invariants of the engine code. Accelerator-matched code
+accretes machine-specific discipline (traced values, compile caches,
+async dispatch) that generic linters cannot see; these checkers close
+that gap the same way DPOW101-901 closed the Clock/async/contract gaps.
+
+DPOW1001 **epoch-fence discipline** — a frontier-mutating write on an
+apply path (``set_base`` calls, per-device ``dev_bases``/``dev_scanned``
+stores, ``device_ema`` EMA credit) not dominated by a comparison against
+the job's current epoch/partition token. An *apply path* is a function
+that reads a launch's ``dev_epochs`` snapshot or takes an ``epoch``
+parameter — the functions that consume device results; dispatch-time
+base advances (which legitimately run unfenced) reference neither and
+are exempt by construction. *Dominated* means an enclosing ``if``/
+``while`` test compares something epoch-ish, or an earlier epoch-guard
+``if`` in the same suite cannot fall through (the ``!= … continue``
+idiom). Deleting the PR-6 guard from ``_apply_plain_rows`` re-fires
+this checker (pinned in tests/test_analysis.py).
+
+DPOW1002 **traced-value leakage** — Python ``if``/``while``/``assert``/
+``bool()`` on a value produced by a jax/jnp/lax op inside a function
+that jax traces: a def decorated with ``jit``/``pmap`` (bare or via
+``functools.partial``), or passed by name to ``jax.jit``/``jax.pmap``/
+``lax.while_loop``/``lax.scan``/``lax.cond`` (one-level call
+resolution, the DPOW801 helper model). Inside ``lax.*`` callees every
+parameter is traced and counts as tainted; ``jit``/``pmap`` parameters
+may be static, so only jnp/lax-derived values taint there (documented
+blind spot). Branching on static Python config (``if kernel ==
+'pallas'``) stays clean.
+
+DPOW1003 **recompile/warm-ladder hazard** — (a) a call to a
+jit-wrapped function passing a non-hashable display (list/dict/set/
+comprehension) or an f-string (per-request-varying ⇒ one compile-cache
+entry per distinct value) to one of its declared ``static_argnames``,
+or a non-hashable display to an ``lru_cache`` compile-factory; (b) in a
+class that owns the ``_warm`` shape set, a method that submits a device
+launch (``_submit_launch``/``_timed_launch``/``_launch``) with a
+non-constant step count while never consulting the warm ladder
+(``_warm`` / ``_pick_shape``) — the PR-4 soak flake (a cold compile on
+the dispatch path) as lint, not just a test.
+
+DPOW1004 **slot/launch lifetime** — (a) a control-slot ``release``
+(``ctl.release``/``control.release``) reachable outside a ``finally``
+block: the slot must live exactly as long as the launch thread, and an
+early release feeds a still-running loop dead zeros and UNDOES its
+cancel/kill flags (the PR-10/PR-12 zombie); (b) a launch-thread
+liveness judgment made from the asyncio wrapper (``rec.fut.done()`` /
+``.cancelled()``) instead of the ``thread_done`` Event — cancelling the
+wrapper's waiter marks it done while the executor thread may still be
+wedged. A ``.fut``-based check is exempt when the enclosing function
+tested ``thread_done`` first (the sanctioned None-fallback idiom).
+
+All stdlib-``ast``, one parse per file (core.SourceFile), standard
+waiver syntax. Known blind spots are catalogued in docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, dotted_name, resolve_call
+from .concurrency import _terminates
+
+CODE_EPOCH = "DPOW1001"
+CODE_TRACED = "DPOW1002"
+CODE_WARM = "DPOW1003"
+CODE_SLOT = "DPOW1004"
+
+#: checker families this module contributes (aggregated into the
+#: registry in __init__.py — the families=N headline denominator)
+FAMILIES = (
+    ("epoch-fence", (CODE_EPOCH,)),
+    ("traced-leak", (CODE_TRACED,)),
+    ("warm-ladder", (CODE_WARM,)),
+    ("slot-lifetime", (CODE_SLOT,)),
+)
+
+
+def own_nodes(fn: ast.AST) -> List[ast.AST]:
+    """``fn``'s own statements/expressions in source (pre-)order, PRUNING
+    nested function/lambda subtrees — ``ast.walk`` can do neither (it is
+    breadth-first and cannot skip a subtree), and both properties matter
+    here: taint must propagate in execution order, and a nested def's
+    body must be judged on its own merits, not under the enclosing
+    function's taint/ownership."""
+    out: List[ast.AST] = []
+    stack = list(reversed(list(ast.iter_child_nodes(fn))))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DPOW1001 epoch-fence discipline
+# ---------------------------------------------------------------------------
+
+#: attribute roots whose element stores move the scan frontier / credit —
+#: exactly the state a stale-epoch launch must never touch
+_FRONTIER_SUBSCRIPTS = {"dev_bases", "dev_scanned", "device_ema"}
+
+
+def _mentions_epoch(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "epoch" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "epoch" in node.attr.lower():
+            return True
+    return False
+
+
+def _epoch_compare(test: ast.AST) -> bool:
+    """Does this test contain a comparison against an epoch-ish value?
+    (``epoch == job.dev_epoch``, ``rec.dev_epochs[row] != job.dev_epoch``,
+    buried in ``and``/``or`` chains included.)"""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and (
+            _mentions_epoch(node.left)
+            or any(_mentions_epoch(c) for c in node.comparators)
+        ):
+            return True
+    return False
+
+
+def _is_apply_path(fn: ast.AST) -> bool:
+    """A function that consumes launch results: it reads a per-launch
+    ``dev_epochs`` snapshot or takes the epoch as a parameter. Dispatch
+    paths (which advance bases unfenced, legitimately) do neither."""
+    args = fn.args
+    for a in args.args + args.kwonlyargs + args.posonlyargs:
+        if a.arg in ("epoch", "epochs", "epoch_dev"):
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == "dev_epochs":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "dev_epochs":
+            return True
+    return False
+
+
+def _frontier_writes(stmt: ast.stmt) -> List[Tuple[int, str]]:
+    """(line, what) frontier mutations lexically inside one statement
+    (nested function/lambda bodies run under their own caller and are
+    pruned)."""
+    out: List[Tuple[int, str]] = []
+    for node in [stmt] + own_nodes(stmt):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "set_base"
+        ):
+            out.append((node.lineno, f"{dotted_name(node.func) or 'set_base'}()"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                el = t
+                if isinstance(el, ast.Subscript):
+                    el = el.value
+                if (
+                    isinstance(el, ast.Attribute)
+                    and el.attr in _FRONTIER_SUBSCRIPTS
+                ):
+                    out.append((t.lineno, f"{dotted_name(el) or el.attr} store"))
+    return out
+
+
+class _FenceScan:
+    """Walk one apply-path function recording frontier writes that no
+    epoch comparison dominates."""
+
+    def __init__(self):
+        self.unfenced: List[Tuple[int, str]] = []
+
+    def scan(self, body: List[ast.stmt], guarded: bool) -> None:
+        for stmt in body:
+            self._stmt(stmt, guarded)
+            if (
+                isinstance(stmt, ast.If)
+                and _epoch_compare(stmt.test)
+                and (
+                    _terminates(stmt.body)
+                    or (bool(stmt.orelse) and _terminates(stmt.orelse))
+                )
+            ):
+                # Early-exit epoch guard (``if epoch != …: continue``):
+                # everything after it in this suite runs epoch-checked.
+                guarded = True
+
+    def _stmt(self, stmt: ast.stmt, guarded: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.If):
+            sub = guarded or _epoch_compare(stmt.test)
+            # We cannot know which arm holds the CURRENT epoch, but either
+            # arm of an epoch test is epoch-aware code — the bug class is
+            # the write with no comparison anywhere above it.
+            self.scan(stmt.body, sub)
+            self.scan(stmt.orelse, sub)
+            return
+        if isinstance(stmt, ast.While):
+            sub = guarded or _epoch_compare(stmt.test)
+            self.scan(stmt.body, sub)
+            self.scan(stmt.orelse, guarded)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.scan(stmt.body, guarded)
+            self.scan(stmt.orelse, guarded)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.scan(stmt.body, guarded)
+            return
+        if isinstance(stmt, ast.Try):
+            self.scan(stmt.body, guarded)
+            for h in stmt.handlers:
+                self.scan(h.body, guarded)
+            self.scan(stmt.orelse, guarded)
+            self.scan(stmt.finalbody, guarded)
+            return
+        if not guarded:
+            self.unfenced.extend(_frontier_writes(stmt))
+
+
+def check_epoch_fence(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.sources():
+        if "epoch" not in src.text:
+            continue  # apply paths carry the epoch by definition
+        for fn in src.nodes():
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_apply_path(fn):
+                continue
+            scan = _FenceScan()
+            scan.scan(fn.body, False)
+            for line, what in scan.unfenced:
+                findings.append(
+                    Finding(
+                        src.rel,
+                        line,
+                        CODE_EPOCH,
+                        f"frontier-mutating {what} on the apply path "
+                        f"('{fn.name}' consumes a launch epoch snapshot) "
+                        "with no dominating epoch comparison: a result of "
+                        "a launch dispatched before a re-partition could "
+                        "rewind the frontier into a re-covered range — "
+                        "fence it on the job's current epoch "
+                        "(docs/device_sharding.md, epoch fencing)",
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DPOW1002 traced-value leakage
+# ---------------------------------------------------------------------------
+
+#: wrapper call leaves that mark their function arguments as traced; the
+#: lax control-flow callees additionally trace every parameter
+_TRACE_WRAPPERS = {"jit", "pmap"}
+_LAX_WRAPPERS = {"while_loop", "scan", "cond", "fori_loop", "switch"}
+
+
+def _jaxish_call(node: ast.Call, aliases: Dict[str, str]) -> bool:
+    """A call whose result is a traced array: jnp.*, lax.*, jax.*."""
+    target = resolve_call(node, aliases) or ""
+    head = target.split(".")[0]
+    return head in ("jax", "jnp", "lax")
+
+
+def _decorated_traced(fn, aliases: Dict[str, str]) -> bool:
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name is None and isinstance(dec, ast.Call):
+            target = resolve_call(dec, aliases) or ""
+            if target.rsplit(".", 1)[-1] == "partial" and dec.args:
+                name = dotted_name(dec.args[0])
+            else:
+                name = dotted_name(dec.func)
+        if name and name.rsplit(".", 1)[-1] in _TRACE_WRAPPERS:
+            return True
+    return False
+
+
+def _collect_traced_defs(src) -> Dict[int, bool]:
+    """id(def) -> params_traced for every function jax will trace: bare or
+    partial-decorated defs, and defs passed by name to jit/pmap/lax
+    control flow (one-level resolution)."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in src.nodes():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    traced: Dict[int, bool] = {}
+    for node in src.nodes():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _decorated_traced(node, src.aliases):
+                traced.setdefault(id(node), False)
+        elif isinstance(node, ast.Call):
+            target = resolve_call(node, src.aliases) or ""
+            leaf = target.rsplit(".", 1)[-1]
+            if leaf in _TRACE_WRAPPERS:
+                params_traced = False
+            elif leaf in _LAX_WRAPPERS:
+                params_traced = True
+            else:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    for fn in defs_by_name.get(arg.id, ()):
+                        traced[id(fn)] = traced.get(id(fn), False) or params_traced
+    return traced
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str], aliases) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+        if isinstance(node, ast.Call) and _jaxish_call(node, aliases):
+            return True
+    return False
+
+
+def check_traced_leak(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.sources():
+        if "jax" not in src.text and "lax" not in src.text:
+            continue
+        traced = _collect_traced_defs(src)
+        if not traced:
+            continue
+        for fn in src.nodes():
+            if id(fn) not in traced:
+                continue
+            tainted: Set[str] = set()
+            if traced[id(fn)]:  # lax callee: every parameter is traced
+                args = fn.args
+                tainted |= {
+                    a.arg
+                    for a in args.args + args.kwonlyargs + args.posonlyargs
+                }
+
+            def _flag(line: int, what: str) -> None:
+                findings.append(
+                    Finding(
+                        src.rel,
+                        line,
+                        CODE_TRACED,
+                        f"Python {what} on a traced value inside "
+                        f"'{fn.name}' (jax traces this function): the "
+                        "branch forces a concretization that either "
+                        "fails under jit or silently bakes one trace-"
+                        "time value into the compiled program — use "
+                        "lax.cond/jnp.where/lax.while_loop instead",
+                    )
+                )
+
+            for node in own_nodes(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    if node.value is not None and _expr_tainted(
+                        node.value, tainted, src.aliases
+                    ):
+                        for t in targets:
+                            for el in (
+                                t.elts if isinstance(t, ast.Tuple) else [t]
+                            ):
+                                if isinstance(el, ast.Name):
+                                    tainted.add(el.id)
+                elif isinstance(node, ast.If) and _expr_tainted(
+                    node.test, tainted, src.aliases
+                ):
+                    _flag(node.lineno, "if")
+                elif isinstance(node, ast.While) and _expr_tainted(
+                    node.test, tainted, src.aliases
+                ):
+                    _flag(node.lineno, "while")
+                elif isinstance(node, ast.Assert) and _expr_tainted(
+                    node.test, tainted, src.aliases
+                ):
+                    _flag(node.lineno, "assert")
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "bool"
+                    and node.args
+                    and _expr_tainted(node.args[0], tainted, src.aliases)
+                ):
+                    _flag(node.lineno, "bool()")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DPOW1003 recompile/warm-ladder hazard
+# ---------------------------------------------------------------------------
+
+#: displays that are unhashable (or vary per construction) — poison for a
+#: jit static argument or an lru_cache compile-factory key
+_UNHASHABLE = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+    ast.GeneratorExp,
+)
+
+#: launch-submitting method names (the engine's executor seam) and the
+#: wrapper methods exempt from the warm-ladder rule (they ARE the seam)
+_SUBMITTERS = ("_submit_launch", "_timed_launch", "_launch")
+_WARM_SOURCES = ("_warm", "_pick_shape")
+
+
+def _static_argnames(fn, aliases) -> Optional[Tuple[str, ...]]:
+    """The literal static_argnames tuple of a jit-partial decorator."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        target = resolve_call(dec, aliases) or ""
+        is_partial = target.rsplit(".", 1)[-1] == "partial"
+        inner = dotted_name(dec.args[0]) if (is_partial and dec.args) else None
+        direct = dotted_name(dec.func)
+        wrapped = (
+            (inner and inner.rsplit(".", 1)[-1] in _TRACE_WRAPPERS)
+            or (direct and direct.rsplit(".", 1)[-1] in _TRACE_WRAPPERS)
+        )
+        if not wrapped:
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames" and isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ):
+                names = tuple(
+                    e.value
+                    for e in kw.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+                return names
+            if kw.arg == "static_argnames" and isinstance(kw.value, ast.Constant):
+                return (str(kw.value.value),)
+        return ()
+    return None
+
+
+def _lru_cached(fn, aliases) -> bool:
+    for dec in fn.decorator_list:
+        name = dotted_name(dec.func) if isinstance(dec, ast.Call) else dotted_name(dec)
+        if name and name.rsplit(".", 1)[-1] == "lru_cache":
+            return True
+    return False
+
+
+def check_warm_ladder(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    # repo-wide tables: jit static-arg surfaces and lru_cache factories,
+    # resolved by leaf name (the project calls them unqualified or via a
+    # module alias; a same-named foreign function is a documented blind
+    # spot, not a crash).
+    static_by_name: Dict[str, Tuple[str, ...]] = {}
+    cached_names: Set[str] = set()
+    sources = project.sources()
+    for src in sources:
+        for fn in src.nodes():
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            statics = _static_argnames(fn, src.aliases)
+            if statics:
+                static_by_name[fn.name] = statics
+            if _lru_cached(fn, src.aliases):
+                cached_names.add(fn.name)
+
+    for src in sources:
+        # (a) hazardous arguments into compile caches
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve_call(node, src.aliases) or ""
+            leaf = target.rsplit(".", 1)[-1]
+            statics = static_by_name.get(leaf)
+            if statics:
+                for kw in node.keywords:
+                    if kw.arg not in statics:
+                        continue
+                    if isinstance(kw.value, _UNHASHABLE):
+                        findings.append(
+                            Finding(
+                                src.rel,
+                                kw.value.lineno,
+                                CODE_WARM,
+                                f"non-hashable value for static argument "
+                                f"'{kw.arg}' of jitted '{leaf}': the "
+                                "compile cache cannot key it — this "
+                                "raises (or retraces) at dispatch time",
+                            )
+                        )
+                    elif isinstance(kw.value, ast.JoinedStr):
+                        findings.append(
+                            Finding(
+                                src.rel,
+                                kw.value.lineno,
+                                CODE_WARM,
+                                f"f-string for static argument "
+                                f"'{kw.arg}' of jitted '{leaf}': every "
+                                "distinct value is a fresh trace+compile "
+                                "on the dispatch path — pass a value "
+                                "from a small closed set instead",
+                            )
+                        )
+            if leaf in cached_names:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, _UNHASHABLE):
+                        findings.append(
+                            Finding(
+                                src.rel,
+                                arg.lineno,
+                                CODE_WARM,
+                                f"non-hashable argument to lru_cache "
+                                f"compile factory '{leaf}': the cache "
+                                "key raises TypeError at dispatch — "
+                                "pass a tuple",
+                            )
+                        )
+        # (b) launches bypassing the warm ladder
+        for cls in src.nodes():
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            owns_warm = any(
+                isinstance(n, ast.Attribute) and n.attr == "_warm"
+                for n in ast.walk(cls)
+            )
+            if not owns_warm:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if any(meth.name.startswith(s) for s in _SUBMITTERS) or (
+                    meth.name.startswith("_await_launch")
+                ):
+                    continue  # the seam itself, not a dispatch decision
+                consults_ladder = any(
+                    isinstance(n, ast.Attribute) and n.attr in _WARM_SOURCES
+                    for n in ast.walk(meth)
+                )
+                if consults_ladder:
+                    continue
+                for node in ast.walk(meth):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SUBMITTERS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in ("self", "cls")
+                    ):
+                        continue
+                    steps = None
+                    if len(node.args) >= 2:
+                        steps = node.args[1]
+                    for kw in node.keywords:
+                        if kw.arg == "steps":
+                            steps = kw.value
+                    if steps is None or isinstance(steps, ast.Constant):
+                        continue  # literal shapes are ladder rungs by fiat
+                    findings.append(
+                        Finding(
+                            src.rel,
+                            node.lineno,
+                            CODE_WARM,
+                            f"'{meth.name}' submits a device launch with "
+                            "a computed step count but never consults "
+                            "the warm ladder (self._warm / _pick_shape): "
+                            "an unwarmed shape compiles inline ON the "
+                            "dispatch path and stalls every active "
+                            "request behind it (the PR-4 soak flake)",
+                        )
+                    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DPOW1004 slot/launch lifetime
+# ---------------------------------------------------------------------------
+
+#: the control module that owns the slot table (package-dir-relative)
+CONTROL_MODULE = "ops/control.py"
+
+
+def _is_control_release(node: ast.Call, aliases) -> bool:
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] != "release":
+        return False
+    if len(parts) == 1:
+        # bare ``release(...)`` counts only when imported from control
+        origin = aliases.get("release", "")
+        return origin.endswith("control.release")
+    return parts[-2] in ("ctl", "control")
+
+
+def _finally_lines(tree: ast.AST) -> Set[int]:
+    """Line numbers lexically inside any ``finally:`` suite."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    ln = getattr(sub, "lineno", None)
+                    if ln is not None:
+                        out.add(ln)
+    return out
+
+
+def check_slot_lifetime(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    pkg = project.package_dir.rstrip("/") + "/"
+    for src in project.sources():
+        if src.rel == pkg + CONTROL_MODULE:
+            continue  # the slot table's own module manages its entries
+        if "release" not in src.text and ".fut" not in src.text:
+            continue
+        in_finally = _finally_lines(src.tree)
+        # (a) release outside the launch thread's finally
+        for node in src.nodes():
+            if (
+                isinstance(node, ast.Call)
+                and _is_control_release(node, src.aliases)
+                and node.lineno not in in_finally
+            ):
+                findings.append(
+                    Finding(
+                        src.rel,
+                        node.lineno,
+                        CODE_SLOT,
+                        "control-slot release outside the launch "
+                        "thread's finally: the slot must live exactly "
+                        "as long as the thread — an early release feeds "
+                        "a still-running loop dead zeros and UNDOES its "
+                        "cancel/kill flags (the launch then grinds its "
+                        "whole span while pinning an executor thread)",
+                    )
+                )
+        # (b) fut-based liveness judgments
+        for fn in src.nodes():
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            thread_done_checks = sorted(
+                n.lineno
+                for n in ast.walk(fn)
+                if (isinstance(n, ast.Attribute) and n.attr == "thread_done")
+                or (isinstance(n, ast.Name) and n.id == "thread_done")
+            )
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("done", "cancelled")
+                    and isinstance(node.func.value, ast.Attribute)
+                    and node.func.value.attr == "fut"
+                ):
+                    continue
+                if any(ln < node.lineno for ln in thread_done_checks):
+                    continue  # the sanctioned thread_done-first fallback
+                findings.append(
+                    Finding(
+                        src.rel,
+                        node.lineno,
+                        CODE_SLOT,
+                        f".fut.{node.func.attr}() as a launch-liveness "
+                        "signal: cancelling the asyncio wrapper's waiter "
+                        "marks it done while the executor thread may "
+                        "still be wedged — judge thread return by the "
+                        "thread_done Event (set in the thread's own "
+                        "finally), falling back to fut only when no "
+                        "Event exists",
+                    )
+                )
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    return (
+        check_epoch_fence(project)
+        + check_traced_leak(project)
+        + check_warm_ladder(project)
+        + check_slot_lifetime(project)
+    )

@@ -90,6 +90,7 @@ def _retire_on_done(fut, slot: int) -> None:
     release normally got there first) and consume the outcome."""
 
     def _retire(f, s=slot):
+        # dpowlint: disable=DPOW1004 — retirement backstop for ABANDONED launches only: their control rows are kill-fenced/cancelled before this callback is attached, the thread's own finally-release normally got here first, and release() is idempotent
         ctl.release(s)
         _consume_abandoned(f)
 
@@ -899,20 +900,15 @@ class JaxWorkBackend(WorkBackend):
         # done-callback if it ever returns, and its executor threads are
         # waived from the interpreter-exit join) and COUNT it, instead of
         # blocking shutdown forever.
-        def _returned(rec) -> bool:
-            if rec.thread_done is not None:
-                return rec.thread_done.is_set()
-            return rec.fut.done()
-
         joinable = [
             rec for rec in list(self._inflight)
-            if rec.control is not None and not _returned(rec)
+            if rec.control is not None and not self._launch_returned(rec)
         ]
         if joinable:
             step = max(self.close_join_timeout / 20.0, 0.005)
             deadline = self._clock.time() + self.close_join_timeout
             while (
-                any(not _returned(rec) for rec in joinable)
+                any(not self._launch_returned(rec) for rec in joinable)
                 and self._clock.time() < deadline
             ):
                 # Real-thread rendezvous: thread_done is set from executor
@@ -930,7 +926,7 @@ class JaxWorkBackend(WorkBackend):
                 timer.cancel()
                 poll.cancel()
             for rec in joinable:
-                if _returned(rec):
+                if self._launch_returned(rec):
                     continue
                 rec.control.kill_all()
                 self._m_threads_leaked.inc(1)
@@ -986,6 +982,19 @@ class JaxWorkBackend(WorkBackend):
         the deadline then floors at device_suspect_after)."""
         return self._window_seconds * max(1, self.control_poll_steps)
 
+    @staticmethod
+    def _launch_returned(rec: "_Launch") -> bool:
+        """Has the launch THREAD actually come back? Judged by the
+        thread_done Event (set in the thread's own finally), because the
+        asyncio wrapper lies: a launch-timeout cancels ``rec.fut`` while
+        the executor thread may still be wedged on the device — exactly
+        the launches the close bound and the watchdog exist to catch
+        (dpowlint DPOW1004). ``fut`` stands in only for pre-Event
+        launches (tests installing bare records)."""
+        if rec.thread_done is not None:
+            return rec.thread_done.is_set()
+        return rec.fut.done()
+
     def _watchdog_pass(self) -> None:
         """One sweep over the in-flight launches: progress is read from
         the control channel's per-(row, device) poll/done bookkeeping —
@@ -995,7 +1004,9 @@ class JaxWorkBackend(WorkBackend):
         suspects: list = []
         hung_chunked: list = []
         for rec in list(self._inflight):
-            if rec.fut.done() or rec.abandoned:
+            # thread_done, not fut: a timeout-cancelled wrapper must not
+            # hide a still-wedged launch from the sweep (DPOW1004).
+            if self._launch_returned(rec) or rec.abandoned:
                 continue
             if rec.control is not None:
                 deadline = launch_deadline(
@@ -1055,7 +1066,7 @@ class JaxWorkBackend(WorkBackend):
             return
         wrecked = [
             rec for rec in list(self._inflight)
-            if not rec.fut.done() and not rec.abandoned
+            if not self._launch_returned(rec) and not rec.abandoned
             and d in (rec.fan_map or [0])
         ]
         evacuations: Dict[int, tuple] = {}
@@ -2046,6 +2057,7 @@ class JaxWorkBackend(WorkBackend):
             # straggler poll now reads dead zeros) and export what the
             # channel saw — launch length, polls, commands delivered and
             # their issue→delivery latency on the injectable clock.
+            # dpowlint: disable=DPOW1004 — apply path: the thread already returned its arrays (we hold them), so its finally-release landed first; this is the idempotent belt-and-suspenders release
             ctl.release(rec.slot)
             c = rec.control
             windows_ran = min(c.last_k + self.control_poll_steps, rec.shape[1])

@@ -114,6 +114,7 @@ class QuotaLedger:
             else:
                 allowed = False
                 retry_after = (tokens - have) / self.rate
+            # dpowlint: disable=DPOW1005 — documented last-writer-wins: the per-service asyncio.Lock serializes in-process RMW, and cross-process sharing under-counts at worst one burst per writer (module docstring); the window bound downstream is the hard guarantee
             await self.store.hset(
                 f"{self.PREFIX}{service}",
                 {"tokens": f"{have:.6f}", "stamp": f"{now:.6f}"},

@@ -1547,7 +1547,10 @@ class DpowServer:
                         # path: a failed forward must not strand a
                         # never-resolved proxy for later requests.
                         if self.work_futures.get(block_hash) is proxy:
-                            # dpowlint: disable=DPOW801 — side tables live and die with the work_futures entry; the identity guard above re-validates them after the awaits
+                            # (A DPOW801 waiver sat here from PR 8 until
+                            # DPOW002 flagged it stale: the identity guard
+                            # above IS the nearest re-check, so the checker
+                            # clears this shape on its own.)
                             self._drop_dispatch_state(block_hash)
                         if not proxy.done():
                             proxy.cancel()
