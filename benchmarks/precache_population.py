@@ -1,0 +1,386 @@
+"""Population-scale precache acceptance capture (ISSUE 18) — BENCH_r18.
+
+Two phases, one artifact:
+
+  * ``live``  — a FakeClock open-loop capture against the REAL DpowServer
+    (in-proc broker, synthetic responder): a diurnal request stream with a
+    flash crowd at the crest, coupled to a block-confirmation stream
+    (``ConfirmFeed``) over a Zipf population whose hot head is seeded as
+    known accounts. The autoscaler's ``precache_shed`` lever is thrown for
+    the flash-crowd window (scripted here; the sim phase closes the real
+    feedback loop). Measures the windowed hit ratio per phase, the verdict
+    ladder, on-demand p95 vs the SLO — and calibrates the sim's
+    ``precache_hit`` / ``precache_util`` from what actually happened.
+  * ``sim``   — the calibrated discrete-event twin at population scale:
+    a 1M-account ``ServicePopulation`` through the BENCH_r14 diurnal +
+    10x flash-crowd shape with the REAL ``SLOController`` + journal in the
+    loop, so precache shedding to zero under the crowd and re-opening
+    after the drain emerges from the controller's own
+    ``shed_precache_on/off`` actions, not from a script.
+
+Everything timer-shaped rides FakeClock — minutes of trace play out in
+seconds of wall clock, deterministically. The responder is synthetic
+(fixed solve latency), so numbers isolate the orchestration layer; runs
+without a TPU are labeled ``cpu-fallback`` in the artifact.
+
+Usage: python benchmarks/precache_population.py [--out BENCH_r18.json]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import argparse
+import asyncio
+import io
+import json
+
+from tpu_dpow import obs
+from tpu_dpow.autoscale import AutoscaleConfig, DecisionJournal, SLOController
+from tpu_dpow.autoscale.controller import SHED_OFF, SHED_ON
+from tpu_dpow.loadgen import (
+    ConfirmFeed,
+    DiurnalRate,
+    InprocDriver,
+    OpenLoopDriver,
+    OpenLoopRecorder,
+    ServicePopulation,
+    SpikeOverlay,
+    SyntheticResponder,
+    poisson_schedule,
+)
+from tpu_dpow.loadgen.sim import ClusterSim, SimParams
+from tpu_dpow.resilience import FakeClock
+
+SLO_P95_MS = 2000.0
+
+# live-phase shape: diurnal over one compressed "day", flash crowd at the
+# crest, shed lever held for the crowd + a short drain tail
+LIVE_PERIOD = 240.0
+SPIKE_AT = 120.0
+SPIKE_DURATION = 30.0
+SHED_LIFT = SPIKE_AT + SPIKE_DURATION + 10.0
+
+LIVE_KNOBS = dict(
+    max_inflight_dispatches=16,
+    precache_cache_size=128,
+    precache_watermark=0.9,
+    precache_min_score=0.0,
+    precache_score_half_life=120.0,
+    precache_window_fraction=0.5,
+    precache_lease=10.0,
+)
+
+
+def _pre_counts():
+    snap = obs.snapshot()
+
+    def series(name):
+        fam = snap.get(name) or {}
+        return dict(fam.get("series") or {})
+
+    return {
+        "requests": series("dpow_precache_requests_total"),
+        "decisions": series("dpow_precache_decisions_total"),
+    }
+
+
+def _delta(after, before):
+    keys = set(after) | set(before)
+    return {k: after.get(k, 0) - before.get(k, 0) for k in sorted(keys)}
+
+
+def _ratio(req_delta):
+    hit = req_delta.get("hit", 0)
+    miss = req_delta.get("miss", 0)
+    return round(hit / (hit + miss), 4) if hit + miss else None
+
+
+async def _live(n_requests: int, n_confirms: int, seed: int) -> dict:
+    from tpu_dpow.server import DpowServer, ServerConfig
+    from tpu_dpow.store import MemoryStore
+    from tpu_dpow.transport.broker import Broker
+    from tpu_dpow.transport.inproc import InProcTransport
+
+    obs.reset()
+    clock = FakeClock()
+    broker = Broker()
+    store = MemoryStore()
+    config = ServerConfig(
+        base_difficulty=0xFF00000000000000,
+        throttle=100000.0,
+        heartbeat_interval=3600.0,
+        statistics_interval=3600.0,
+        work_republish_interval=2.0,
+        fleet=False,
+        **LIVE_KNOBS,
+    )
+    server = DpowServer(
+        config, store, InProcTransport(broker, client_id="server"),
+        clock=clock,
+    )
+    pop = ServicePopulation(
+        64, seed=seed, n_accounts=4096, reuse_prob=(0.35, 0.55),
+        cancel_rate=(0.0, 0.0), timeout_median=(8.0, 12.0),
+    )
+    rec = OpenLoopRecorder(clock, window=10.0)
+
+    await server.setup()
+    server.start_loops()
+    await pop.seed_store(store)
+    seeded = await pop.seed_accounts(store, limit=512)
+
+    responder = SyntheticResponder(
+        InProcTransport(broker, client_id="responder"),
+        latency=0.05, clock=clock,
+    )
+    await responder.start()
+    driver = OpenLoopDriver(
+        InprocDriver(server.service_handler), rec,
+        population=pop, clock=clock,
+    )
+    feed = ConfirmFeed([server.block_arrival_handler], pop, clock=clock)
+
+    rate = SpikeOverlay(
+        DiurnalRate(6.0, 14.0, period=LIVE_PERIOD),
+        at=SPIKE_AT, duration=SPIKE_DURATION, factor=8.0,
+    )
+    req_schedule = list(poisson_schedule(rate, n=n_requests, seed=seed + 11))
+    conf_schedule = list(poisson_schedule(12.0, n=n_confirms, seed=seed + 13))
+    span = max(req_schedule[-1].t, conf_schedule[-1].t) + 30.0
+
+    # phase boundaries (sim-time) at which obs counters are snapshotted:
+    # warmup / steady pre-spike / flash crowd (shed on) / recovery
+    boundaries = [60.0, SPIKE_AT, SHED_LIFT]
+    marks = [_pre_counts()]
+    util_samples = []
+    shed_on = False
+
+    try:
+        req_task = asyncio.ensure_future(driver.run(req_schedule))
+        conf_task = asyncio.ensure_future(feed.run(conf_schedule))
+        elapsed, step = 0.0, 0.25
+        while not (req_task.done() and conf_task.done()) and elapsed < span:
+            await clock.advance(step)
+            elapsed += step
+            while boundaries and elapsed >= boundaries[0]:
+                boundaries.pop(0)
+                marks.append(_pre_counts())
+            if not shed_on and SPIKE_AT <= elapsed < SHED_LIFT:
+                server.apply_control({"precache_shed": True})
+                shed_on = True
+            elif shed_on and elapsed >= SHED_LIFT:
+                server.apply_control({"precache_shed": False})
+                shed_on = False
+            if not shed_on and config.max_inflight_dispatches:
+                util_samples.append(
+                    server.admission.precache_inflight
+                    / config.max_inflight_dispatches
+                )
+        for _ in range(400):
+            if req_task.done() and conf_task.done():
+                break
+            await clock.advance(step)
+        summary = await req_task
+        await conf_task
+    finally:
+        await responder.close()
+        await server.close()
+
+    marks.append(_pre_counts())
+    while len(marks) < 5:  # schedule ended before a boundary: pad with end
+        marks.insert(-1, marks[-1])
+    phase_names = ("warmup", "pre_spike", "flash_crowd_shed", "recovery")
+    phases = {}
+    for name, before, after in zip(phase_names, marks, marks[1:]):
+        req_d = _delta(after["requests"], before["requests"])
+        dec_d = _delta(after["decisions"], before["decisions"])
+        phases[name] = {
+            "hit_ratio": _ratio(req_d),
+            "requests": req_d,
+            "verdicts": dec_d,
+        }
+
+    snap = obs.snapshot()
+    total = marks[-1]
+    return {
+        "population": {
+            "services": 64, "accounts": 4096, "accounts_seeded_known": seeded,
+        },
+        "schedule": {
+            "requests": len(req_schedule), "confirmations": len(conf_schedule),
+            "span_s": round(span, 1), "spike_at_s": SPIKE_AT,
+            "spike_duration_s": SPIKE_DURATION, "spike_factor": 8.0,
+            "shed_lever": (
+                f"scripted on at t={SPIKE_AT:.0f}s, off at t={SHED_LIFT:.0f}s "
+                "(autoscaler lever emulated; the sim phase closes the loop)"
+            ),
+        },
+        "summary": summary,
+        "phases": phases,
+        "verdict_totals": total["decisions"],
+        "hit_ratio_overall": _ratio(
+            _delta(total["requests"], marks[0]["requests"])
+        ),
+        "cache_entries": dict(
+            (snap.get("dpow_precache_cache_entries") or {}).get("series") or {}
+        ),
+        "calibration": {
+            "precache_hit": phases["recovery"]["hit_ratio"]
+            or phases["pre_spike"]["hit_ratio"] or 0.0,
+            "precache_util": round(
+                sum(util_samples) / len(util_samples), 4
+            ) if util_samples else 0.0,
+            "service_median_s": round((summary["p50_ms"] or 60.0) / 1e3, 4),
+            "note": (
+                "precache_hit = recovery-phase windowed hit ratio; "
+                "precache_util = mean precache share of the admission "
+                "window while the lever is open; service_median from the "
+                "live p50 (synthetic responder at 50 ms solve latency)"
+            ),
+        },
+    }
+
+
+def _sim(calibration: dict, n: int, seed: int) -> dict:
+    obs.reset()
+    cfg = AutoscaleConfig(
+        slo_p95_ms=SLO_P95_MS, slo_poll_interval=1.0, slo_breach_polls=2,
+        slo_clear_polls=8, slo_cooldown=5.0, slo_max_replicas=3,
+        slo_queue_high=24.0,
+    )
+    ctrl = SLOController(cfg, initial_replicas=1)
+    buf = io.StringIO()
+    journal = DecisionJournal(buf, cfg, initial_state=ctrl.state_dict())
+    # the BENCH_r14 diurnal + flash-crowd shape, scaled to the CALIBRATED
+    # single-replica capacity (window / service_median) so the crowd is an
+    # actual overload for the initial N=1 fleet (10x crest = 1.8x single-
+    # replica capacity) yet servable once the controller sheds precache
+    # and scales out
+    service_median = max(0.05, calibration["service_median_s"])
+    capacity = 8 / service_median
+    lo_rate, hi_rate = 0.08 * capacity, 0.18 * capacity
+    rate = SpikeOverlay(
+        DiurnalRate(lo_rate, hi_rate, period=400.0),
+        at=200.0, duration=60.0, factor=10.0,
+    )
+    sim = ClusterSim(
+        SimParams(
+            window=8, queue_limit=192,
+            service_median=service_median,
+            service_sigma=0.3, spawn_delay=3.0,
+            precache_util=calibration["precache_util"],
+            precache_hit=calibration["precache_hit"],
+        ),
+        replicas=1, seed=seed, controller=ctrl, journal=journal,
+        poll_interval=1.0,
+    )
+    schedule = list(poisson_schedule(rate, n=n, duration=400.0, seed=seed))
+    out = sim.run(
+        schedule,
+        ServicePopulation(1000, seed=seed, n_accounts=1_000_000),
+        slo_p95_ms=SLO_P95_MS,
+    )
+
+    buf.seek(0)
+    shed_on_t, shed_off_t = [], []
+    hit_signal = []  # (t, precache_hit_ratio) per poll
+    for line in buf.read().splitlines()[1:]:
+        entry = json.loads(line)
+        for a in entry.get("actions", []):
+            if a["kind"] == SHED_ON:
+                shed_on_t.append(entry["t"])
+            elif a["kind"] == SHED_OFF:
+                shed_off_t.append(entry["t"])
+        hr = entry["signals"].get("precache_hit_ratio")
+        if hr is not None:
+            hit_signal.append((entry["t"], hr))
+
+    def mean_hr(lo, hi):
+        vals = [v for t, v in hit_signal if lo <= t < hi]
+        return round(sum(vals) / len(vals), 4) if vals else None
+
+    first_on = shed_on_t[0] if shed_on_t else None
+    first_off = next(
+        (t for t in shed_off_t if first_on is not None and t > first_on), None
+    )
+    return {
+        "population": {"services": 1000, "accounts": 1_000_000},
+        "arrivals": len(schedule),
+        "shape": (
+            f"diurnal {lo_rate:.0f}-{hi_rate:.0f} req/s "
+            "(period 400 s, scaled to calibrated capacity), 10x flash "
+            "crowd at crest (~1.8x single-replica capacity)"
+        ),
+        "summary": out.summary,
+        "peak_replicas": out.peak_replicas,
+        "precache_hits": out.precache_hits,
+        "store_hits": out.store_hits,
+        "coalesced": out.coalesced,
+        "controller": {
+            "shed_precache_on_t": [round(t, 1) for t in shed_on_t],
+            "shed_precache_off_t": [round(t, 1) for t in shed_off_t],
+            "hit_ratio_before_shed": (
+                mean_hr(0.0, first_on) if first_on is not None
+                else mean_hr(0.0, 1e9)
+            ),
+            "hit_ratio_during_shed": (
+                mean_hr(first_on, first_off)
+                if first_on is not None and first_off is not None else None
+            ),
+            "hit_ratio_after_reopen": (
+                mean_hr(first_off, 1e9) if first_off is not None else None
+            ),
+        },
+    }
+
+
+def main() -> None:
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_r18.json")
+    p.add_argument("--live_requests", type=int, default=5500)
+    p.add_argument("--live_confirms", type=int, default=2400)
+    p.add_argument("--sim_n", type=int, default=80000)
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args()
+
+    live = asyncio.run(
+        asyncio.wait_for(
+            _live(args.live_requests, args.live_confirms, args.seed),
+            timeout=1800,
+        )
+    )
+    sim = _sim(live["calibration"], args.sim_n, args.seed)
+
+    import jax
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    artifact = {
+        "bench": "precache_population",
+        "issue": 18,
+        "platform": "tpu" if on_tpu else "cpu-fallback",
+        "responder": "synthetic (fixed 50 ms solve latency; orchestration-"
+                     "layer capture, device compute excluded)",
+        "slo_p95_ms": SLO_P95_MS,
+        "knobs": dict(LIVE_KNOBS),
+        "live": live,
+        "sim": sim,
+    }
+    with open(args.out, "w", encoding="utf-8") as fp:
+        json.dump(artifact, fp, indent=2)
+        fp.write("\n")
+    print(json.dumps({
+        "out": args.out,
+        "live_hit_ratio": {k: v["hit_ratio"] for k, v in live["phases"].items()},
+        "live_p95_ms": live["summary"]["p95_ms"],
+        "sim_p95_ms": sim["summary"]["p95_ms"],
+        "sim_shed_on": sim["controller"]["shed_precache_on_t"],
+        "sim_shed_off": sim["controller"]["shed_precache_off_t"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

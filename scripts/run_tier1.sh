@@ -79,6 +79,20 @@ if timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
 else
     echo "LOADGEN=fail"
 fi
+# Population-scale precache headline (ISSUE 18): the ring-gating chaos
+# acceptance (exactly one replica precaches a routed confirmation) re-run
+# standalone — pass/fail, not a log grep — plus the scorer/cache/pipeline
+# pin count (tests/test_precache.py). docs/precache.md is the catalogue.
+if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_precache.py -k ring_gating \
+    -q -p no:cacheprovider >/dev/null 2>&1; then
+    PRECACHE_TESTS=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_precache.py --collect-only -q -p no:cacheprovider \
+        2>/dev/null | grep -c '::' || true)
+    echo "PRECACHE=pass tests=${PRECACHE_TESTS}"
+else
+    echo "PRECACHE=fail"
+fi
 # dpowlint headline (ISSUE 5, families since ISSUE 15): the repo's own
 # invariant checkers — clean or the escaped-finding count, plus the
 # active checker-family count parsed from the run's own summary line, so
@@ -107,9 +121,9 @@ fi
 # DPOW_SAN_SEEDS degrades to the default here exactly as it does for
 # python -m tpu_dpow.analysis --san.
 SAN_SEEDS=$(python -c "from tpu_dpow.analysis.sanitizer import _env_int; print(_env_int('DPOW_SAN_SEEDS', 20))" 2>/dev/null || echo 20)
-# (timeout covers four scenarios since ISSUE 12 added devfault — the jax
-# engine replay costs ~1s/seed on this box after the first compile)
-DPOWSAN_OUT=$(timeout -k 10 420 env JAX_PLATFORMS=cpu python -c "
+# (timeout covers six scenarios — devfault's jax engine replay costs
+# ~1s/seed on this box after the first compile; the rest are sub-second)
+DPOWSAN_OUT=$(timeout -k 10 480 env JAX_PLATFORMS=cpu python -c "
 import sys
 from tpu_dpow.analysis import sanitizer
 report = sanitizer.run_seeds(sanitizer._env_int('DPOW_SAN_SEEDS', 20))

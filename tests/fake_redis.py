@@ -1,11 +1,12 @@
 """In-process ``redis.asyncio``-compatible client for the Store contract suite.
 
-Implements exactly the operation surface RedisStore uses — get / set(px, nx)
-/ delete / exists / incrby / hset / hget / hgetall / hincrby / sadd / srem /
-smembers / keys / ping / aclose — with real-redis semantics:
+Implements exactly the operation surface RedisStore uses — get / set(px, nx,
+get) / delete / exists / incrby / hset / hget / hgetall / hincrby / sadd /
+srem / smembers / keys / ping / aclose — with real-redis semantics:
 
   * lazy millisecond TTL expiry (px), set-without-px clearing a prior TTL;
   * set(nx=True) returning None when the key exists, True otherwise;
+  * set(get=True) returning the prior string value (SET ... GET);
   * WRONGTYPE ResponseError when an op hits a key of another kind;
   * decode_responses=True behavior (everything is str).
 
@@ -68,16 +69,19 @@ class FakeRedis:
         value: str,
         px: Optional[int] = None,
         nx: bool = False,
-    ) -> Optional[bool]:
+        get: bool = False,
+    ) -> Optional[object]:
+        old = self._typed(key, str)  # WRONGTYPE against hash/set keys
         if nx and self._live(key):
-            return None
-        self._typed(key, str)  # WRONGTYPE against hash/set keys
+            # real-redis SET NX GET: the old value comes back either way;
+            # without GET a refused SET NX answers None
+            return old if get else None
         self._data[key] = str(value)
         if px is not None:
             self._expiry[key] = self._clock() + px / 1000.0
         else:
             self._expiry.pop(key, None)  # plain SET clears any TTL
-        return True
+        return old if get else True
 
     async def incrby(self, key: str, amount: int = 1) -> int:
         current = self._typed(key, str)

@@ -1530,6 +1530,50 @@ def test_store_atomicity_quiet_on_primitives_fence_and_foreign_keys(tmp_path):
     assert atomicity.check(project) == []
 
 
+def test_store_atomicity_watches_account_and_precache_prefixes(tmp_path):
+    """ISSUE 18 extended the shared key spaces: the account-frontier and
+    precache-score tables are multi-replica state now, so a plain RMW on
+    them must fire — while the sanctioned getset fence stays quiet."""
+    assert "account:" in atomicity.PREFIXES
+    assert "precache:" in atomicity.PREFIXES
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/frontier.py": (
+                "async def advance_lost_update(store, account, h):\n"
+                "    old = await store.get(f'account:{account}')\n"
+                "    await store.set(f'account:{account}', h)\n\n"
+                "async def score_lost_update(store, account):\n"
+                "    rec = await store.hgetall(f'precache:score:{account}')\n"
+                "    await store.hset(f'precache:score:{account}', rec)\n\n"
+                "async def advance_fenced(store, account, h):\n"
+                "    stale = await store.get(f'account:{account}')\n"
+                "    old = await store.getset(f'account:{account}', h)\n"
+                "    return old\n"
+            )
+        },
+    )
+    found = atomicity.check(project)
+    assert len(found) == 2 and codes(found) == ["DPOW1005"]
+    messages = " | ".join(f.message for f in found)
+    assert "account:" in messages and "precache:" in messages
+
+
+def test_store_atomicity_waiver_free_on_the_real_repo():
+    """The frontier fence keeps the new prefixes waiver-free: the shipped
+    tree passes DPOW1005 with only the documented quota.py waiver — no
+    new inline waiver rode in with the precache subsystem."""
+    precache_dir = REPO_ROOT / "tpu_dpow" / "precache"
+    for f in precache_dir.glob("*.py"):
+        assert "disable=DPOW1005" not in f.read_text(encoding="utf-8"), f
+    project = Project(REPO_ROOT)
+    # the raw checker still names quota.py's documented (waived) contract;
+    # nothing else in the tree — in particular nothing under the two new
+    # prefixes — may fire
+    found = atomicity.check(project)
+    assert all(f.path.endswith("sched/quota.py") for f in found), found
+
+
 def test_store_atomicity_real_quota_waiver_is_load_bearing():
     """The shipped QuotaLedger waiver must stay honest: stripping the
     inline waiver from a pristine copy of sched/quota.py re-fires

@@ -1,0 +1,540 @@
+"""Population-scale precache (tpu_dpow/precache/): scorer, bounded cache,
+pipeline verdict ladder, window-fraction shaping, frontier fence, ring
+gating.
+
+Unit layers run against MemoryStore + FakeClock with stub fleet/tracer;
+the ring-gating acceptance runs two real DpowServers over one shared
+store, exactly like the replication chaos tests. Everything here is
+FakeClock-driven — no wall-clock sleeps.
+"""
+
+import asyncio
+
+import pytest
+
+from tpu_dpow.precache import AccountScorer, PrecacheCache, PrecachePipeline
+from tpu_dpow.precache import cache as cache_mod
+from tpu_dpow.precache import pipeline as pipeline_mod
+from tpu_dpow.resilience.clock import FakeClock
+from tpu_dpow.sched.admission import AdmissionController
+from tpu_dpow.store import MemoryStore
+
+EASY = 0xF000000000000000
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+def h(i: int) -> str:
+    return f"{i:064X}"
+
+
+class StubFleet:
+    def __init__(self):
+        self.published = []
+        self.forgotten = []
+
+    async def publish_work(self, block_hash, difficulty, work_type, trace_id=None):
+        self.published.append((block_hash, work_type))
+
+    def forget(self, block_hash):
+        self.forgotten.append(block_hash)
+
+
+class StubTracer:
+    def begin(self, key=None, stage="accept"):
+        return f"trace-{key}"
+
+    def mark(self, trace_id, stage):
+        pass
+
+
+def make_pipeline(
+    store,
+    clock,
+    *,
+    window=8,
+    fraction=1.0,
+    lease=30.0,
+    capacity=8,
+    watermark=1.0,
+    min_score=0.0,
+    debug=False,
+    **pipe_kw,
+):
+    admission = AdmissionController(
+        store,
+        clock=clock,
+        window=window,
+        precache_lease=lease,
+        precache_window_fraction=fraction,
+    )
+    scorer = AccountScorer(store, clock=clock, half_life=900.0)
+    cache = PrecacheCache(
+        capacity=capacity, watermark=watermark, min_score=min_score, clock=clock
+    )
+    fleet = StubFleet()
+    pipe = PrecachePipeline(
+        store,
+        admission,
+        fleet,
+        StubTracer(),
+        scorer,
+        cache,
+        base_difficulty=EASY,
+        debug=debug,
+        clock=clock,
+        **pipe_kw,
+    )
+    return pipe, admission, cache, fleet
+
+
+# ---------------------------------------------------------------------------
+# scorer
+# ---------------------------------------------------------------------------
+
+
+def test_scorer_folds_and_decays_on_the_clock():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        scorer = AccountScorer(store, clock=clock, half_life=100.0)
+        assert scorer.score("a") == 0.0
+        s1 = await scorer.observe("a")
+        s2 = await scorer.observe("a")
+        assert s1 == pytest.approx(1.0) and s2 == pytest.approx(2.0)
+        await clock.advance(100.0)  # one half-life
+        assert scorer.score("a") == pytest.approx(1.0)
+        # a fold after decay lands on the decayed base, not the raw one
+        assert await scorer.observe("a") == pytest.approx(2.0)
+
+    run(main())
+
+
+def test_scorer_watermark_prune_bounds_table_and_persisted_set():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        scorer = AccountScorer(
+            store, clock=clock, half_life=100.0,
+            max_accounts=10, persist_floor=0.0, persist_interval=0.0,
+        )
+        # the hot head confirms repeatedly; a long tail arrives once each
+        for _ in range(5):
+            await scorer.observe("hot")
+        for i in range(30):
+            await clock.advance(1.0)
+            await scorer.observe(f"cold-{i}")
+        assert len(scorer) <= 10
+        assert scorer.score("hot") > 1.0  # the head survives every prune
+        # evicted accounts lose their store records too: the persisted set
+        # stays as bounded as the table
+        keys = await store.keys("precache:score:*")
+        assert len(keys) <= 10
+        assert "precache:score:hot" in keys
+
+    run(main())
+
+
+def test_scorer_persistence_roundtrip_rehydrates_hot_head():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        scorer = AccountScorer(
+            store, clock=clock, half_life=900.0,
+            persist_floor=1.0, persist_interval=0.0,
+        )
+        for _ in range(3):
+            await scorer.observe("hot")
+        reborn = AccountScorer(store, clock=FakeClock(), half_life=900.0)
+        assert await reborn.load() >= 1
+        # written moments ago ⇒ negligible wall decay
+        assert reborn.score("hot") == pytest.approx(3.0, rel=0.05)
+
+    run(main())
+
+
+def test_scorer_load_drops_corrupt_records():
+    async def main():
+        store = MemoryStore()
+        await store.hset("precache:score:junk", {"score": "banana"})
+        scorer = AccountScorer(store, clock=FakeClock())
+        assert await scorer.load() == 0
+        assert await store.hgetall("precache:score:junk") in (None, {})
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_admission_duplicate_floor_and_watermark():
+    clock = FakeClock()
+    cache = PrecacheCache(capacity=4, watermark=0.5, min_score=1.0, clock=clock)
+    assert cache.precheck(h(1), 5.0) is None
+    cache.insert(h(1), "a", 5.0)
+    # duplicate always refused, even with force (debug)
+    assert cache.precheck(h(1), 9.0) == cache_mod.REFUSE_DUPLICATE
+    assert cache.precheck(h(1), 9.0, force=True) == cache_mod.REFUSE_DUPLICATE
+    # below the score floor
+    assert cache.precheck(h(2), 0.5) == cache_mod.REFUSE_SCORE_FLOOR
+    assert cache.precheck(h(2), 0.5, force=True) is None  # debug bypass
+    # inside the watermark zone (occupancy >= 0.5*4 = 2) a newcomer must
+    # beat the lowest-scored resident
+    cache.insert(h(2), "b", 2.0)
+    assert cache.precheck(h(3), 2.0) == cache_mod.REFUSE_BELOW_CACHED
+    assert cache.precheck(h(3), 3.0) is None
+
+
+def test_cache_hard_bound_evicts_lowest_and_never_exceeds_capacity():
+    clock = FakeClock()
+    cache = PrecacheCache(capacity=2, watermark=1.0, clock=clock)
+    cache.insert(h(1), "a", 1.0)
+    cache.insert(h(2), "b", 5.0)
+    _, evicted = cache.insert(h(3), "c", 3.0)
+    assert evicted is not None and evicted.block_hash == h(1)
+    assert len(cache) == 2 and h(1) not in cache
+
+    _, evicted = cache.insert(h(4), "d", 9.0)
+    assert evicted.block_hash == h(3)  # lowest of the survivors
+    assert len(cache) == 2
+
+
+def test_cache_hit_ratio_sliding_window():
+    async def main():
+        clock = FakeClock()
+        cache = PrecacheCache(capacity=4, hit_window=100.0, clock=clock)
+        assert cache.hit_ratio() is None  # no signal, not 0.0
+        cache.note_request(True)
+        cache.note_request(True)
+        cache.note_request(False)
+        assert cache.hit_ratio() == pytest.approx(2 / 3)
+        await clock.advance(101.0)
+        assert cache.hit_ratio() is None  # the window emptied
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# admission: the precache window fraction
+# ---------------------------------------------------------------------------
+
+
+def test_window_fraction_caps_precache_share_but_not_ondemand():
+    async def main():
+        clock = FakeClock()
+        admission = AdmissionController(
+            MemoryStore(), clock=clock, window=4,
+            precache_window_fraction=0.5,
+        )
+        assert admission.try_acquire_precache(h(1), difficulty=EASY)
+        assert admission.try_acquire_precache(h(2), difficulty=EASY)
+        # the speculative share (2 of 4 slots) is spent: shed, not queue
+        assert admission.try_acquire_precache(h(3), difficulty=EASY) is None
+        assert admission.precache_inflight == 2
+        # on-demand still sees the free half of the window
+        ticket = await admission.acquire_dispatch(
+            h(4), "svc", difficulty=EASY, deadline=clock.time() + 5
+        )
+        assert admission.window.inflight == 3
+        admission.release(ticket)
+        # releasing a lease reopens the share
+        admission.release_key(h(1))
+        assert admission.try_acquire_precache(h(3), difficulty=EASY)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# pipeline: the verdict ladder
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_verdict_ladder():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        pipe, admission, cache, fleet = make_pipeline(store, clock)
+
+        # unknown: no frontier, no precached previous, not debug
+        assert await pipe.on_confirmation(h(1), "acct", None) == "unknown_account"
+
+        await store.set("account:acct", h(10))
+        assert await pipe.on_confirmation(h(11), "acct", h(10)) == "dispatch"
+        assert await store.get(f"block:{h(11)}") == pipeline_mod.WORK_PENDING
+        assert await store.get(f"work-type:{h(11)}") == "precache"
+        assert await store.get("account:acct") == h(11)
+        assert fleet.published == [(h(11), "precache")]
+        assert admission.has_lease(h(11)) and h(11) in cache
+
+        # re-announced frontier
+        assert await pipe.on_confirmation(h(11), "acct", h(10)) == "duplicate"
+
+        # shed lever: counted and dropped before any store I/O
+        admission.shed_precache = True
+        assert await pipe.on_confirmation(h(12), "acct", h(11)) == "shed"
+        admission.shed_precache = False
+
+        # score floor refusal surfaces as the cache's refusal reason
+        cache.min_score = 100.0
+        assert await pipe.on_confirmation(h(12), "acct", h(11)) == "score_floor"
+        cache.min_score = 0.0
+
+        assert pipe.count("dispatch") == 1 and pipe.count("duplicate") == 1
+
+    run(main())
+
+
+def test_pipeline_window_full_sheds_and_unwinds_nothing():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        pipe, admission, cache, fleet = make_pipeline(store, clock, window=1)
+        await store.set("account:a", h(10))
+        await store.set("account:b", h(20))
+        assert await pipe.on_confirmation(h(11), "a", h(10)) == "dispatch"
+        assert await pipe.on_confirmation(h(21), "b", h(20)) == "window_full"
+        assert h(21) not in cache
+        assert not admission.has_lease(h(21))
+        # the refused confirmation did not advance the frontier: the next
+        # confirmation of that account retries cleanly
+        assert await store.get("account:b") == h(20)
+
+    run(main())
+
+
+def test_pipeline_supersede_retires_previous_dispatch():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        pipe, admission, cache, fleet = make_pipeline(store, clock)
+        await store.set("account:a", h(10))
+        assert await pipe.on_confirmation(h(11), "a", h(10)) == "dispatch"
+        assert await pipe.on_confirmation(h(12), "a", h(11)) == "dispatch"
+        # the superseded frontier's dispatch is fully retired: store keys,
+        # admission lease, cache entry, fleet cover
+        assert await store.get(f"block:{h(11)}") is None
+        assert await store.get(f"work-type:{h(11)}") is None
+        assert not admission.has_lease(h(11))
+        assert h(11) not in cache and h(12) in cache
+        assert h(11) in fleet.forgotten
+
+    run(main())
+
+
+def test_pipeline_retire_fires_server_hook_on_every_teardown_path():
+    """Capacity evict, frontier supersede, and shed unwind each fire the
+    retire hook for the torn-down dispatch. The server's hook cancels the
+    hash's work future, so a coalesced on-demand waiter fails over
+    (store re-check → RetryRequest) instead of stranding for its whole
+    timeout on work nobody will deliver (pinned by the dpowsan precache
+    scenario, which caught the strand)."""
+
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        retired = []
+        pipe, admission, cache, fleet = make_pipeline(
+            store, clock, capacity=1, retire_cb=retired.append
+        )
+        await store.set("account:a", h(10))
+        await store.set("account:b", h(20))
+        assert await pipe.on_confirmation(h(11), "a", h(10)) == "dispatch"
+        # capacity evict: a hotter account's dispatch pushes a's entry out
+        # of the capacity-1 bound (beat-the-lowest needs the higher score)
+        await pipe.scorer.observe("b")
+        assert await pipe.on_confirmation(h(21), "b", h(20)) == "dispatch"
+        assert h(11) in retired
+        # frontier supersede: b's next confirmation retires b's previous
+        assert await pipe.on_confirmation(h(22), "b", h(21)) == "dispatch"
+        assert h(21) in retired
+
+        # shed unwind: a queued batch dropped by the lever fires the hook
+        pipe.batch_interval = 10.0
+        await store.set("account:c", h(30))
+        for _ in range(4):
+            await pipe.scorer.observe("c")
+        assert await pipe.on_confirmation(h(31), "c", h(30)) == "dispatch"
+        admission.shed_precache = True
+        assert await pipe.flush() == 0
+        assert h(31) in retired
+
+    run(main())
+
+
+def test_pipeline_frontier_fence_same_hash_race_has_one_winner():
+    """Two replicas hear the same confirmation: the getset fence gives
+    exactly one the dispatch; the loser unwinds its ticket and entry."""
+
+    async def main():
+        clock = FakeClock()
+        shared = MemoryStore(shared=True)
+        pipe_a, adm_a, cache_a, _ = make_pipeline(shared, clock)
+        pipe_b, adm_b, cache_b, _ = make_pipeline(shared, clock)
+        await shared.set("account:a", h(10))
+        verdicts = await asyncio.gather(
+            pipe_a.on_confirmation(h(11), "a", h(10)),
+            pipe_b.on_confirmation(h(11), "a", h(10)),
+        )
+        assert sorted(verdicts) == ["dispatch", "duplicate"]
+        winner_cache, loser_cache = (
+            (cache_a, cache_b) if verdicts[0] == "dispatch" else (cache_b, cache_a)
+        )
+        loser_adm = adm_b if verdicts[0] == "dispatch" else adm_a
+        assert h(11) in winner_cache and h(11) not in loser_cache
+        assert not loser_adm.has_lease(h(11))
+        assert await shared.get("account:a") == h(11)
+
+    run(main())
+
+
+def test_pipeline_result_and_stale_hooks_drive_entry_state():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        pipe, admission, cache, _ = make_pipeline(store, clock)
+        await store.set("account:a", h(10))
+        await pipe.on_confirmation(h(11), "a", h(10))
+        assert cache.get(h(11)).state == cache_mod.PENDING
+        pipe.on_result(h(11), "ondemand")  # wrong type: no-op
+        assert cache.get(h(11)).state == cache_mod.PENDING
+        pipe.on_result(h(11), "precache")
+        assert cache.get(h(11)).state == cache_mod.READY
+        # too-weak precached work forces on-demand: the entry is dropped
+        pipe.on_stale(h(11))
+        assert h(11) not in cache
+
+    run(main())
+
+
+def test_pipeline_batch_flush_fuses_publishes_and_shed_drops_queue():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        pipe, admission, cache, fleet = make_pipeline(
+            store, clock, batch_interval=10.0, batch_size=16
+        )
+        for i in range(3):
+            await store.set(f"account:a{i}", h(100 + i))
+            assert await pipe.on_confirmation(
+                h(200 + i), f"a{i}", h(100 + i)
+            ) == "dispatch"
+        assert fleet.published == []  # fused, not per-block
+        assert await pipe.flush() == 3
+        assert len(fleet.published) == 3
+
+        # queued publishes under a shed flip are dropped and unwound
+        await store.set("account:b", h(110))
+        await pipe.on_confirmation(h(210), "b", h(110))
+        admission.shed_precache = True
+        assert await pipe.flush() == 0
+        assert len(fleet.published) == 3
+        assert h(210) not in cache
+        assert not admission.has_lease(h(210))
+
+    run(main())
+
+
+def test_pipeline_reaps_lease_lapsed_entries():
+    async def main():
+        clock = FakeClock()
+        store = MemoryStore()
+        pipe, admission, cache, _ = make_pipeline(store, clock, lease=5.0)
+        await store.set("account:a", h(10))
+        await pipe.on_confirmation(h(11), "a", h(10))
+        assert pipe.reap_lapsed() == 0  # lease still live
+        await clock.advance(6.0)
+        admission.poll()  # the sweep lapses the lease
+        assert not admission.has_lease(h(11))
+        assert pipe.reap_lapsed() == 1
+        assert h(11) not in cache
+        # ready entries are never reaped: served work has no lease to lapse
+        await pipe.on_confirmation(h(12), "a", h(11))
+        pipe.on_result(h(12), "precache")
+        await clock.advance(6.0)
+        admission.poll()
+        assert pipe.reap_lapsed() == 0 and h(12) in cache
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# ring gating: exactly one replica precaches (chaos/regression acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_gating_exactly_one_replica_precaches():
+    """Every replica hears every node confirmation; without the ring gate
+    each would dispatch the same frontier (N slots, N publishes, an N-way
+    frontier race). Two real servers over one shared store: for each of a
+    batch of confirmations, exactly ONE dispatch happens fleet-wide and
+    the other replica counts not_owner."""
+    from tpu_dpow.replica import owner_of
+    from tpu_dpow.server import DpowServer, ServerConfig, hash_key
+    from tpu_dpow.transport.broker import Broker
+    from tpu_dpow.transport.inproc import InProcTransport
+
+    async def main():
+        clock = FakeClock()
+        broker = Broker()
+        shared = MemoryStore(shared=True)
+
+        def make(rid):
+            config = ServerConfig(
+                base_difficulty=EASY,
+                throttle=1000.0,
+                heartbeat_interval=3600.0,
+                statistics_interval=3600.0,
+                fleet=False,
+                replicas=2,
+                replica_id=rid,
+                replica_ttl=2.0,
+                replica_heartbeat_interval=3600.0,
+            )
+            return DpowServer(
+                config, shared,
+                InProcTransport(broker, client_id=f"server-{rid}"),
+                clock=clock,
+            )
+
+        a, b = make("ra"), make("rb")
+        await shared.hset(
+            "service:svc",
+            {"api_key": hash_key("secret"), "public": "N",
+             "display": "svc", "website": "", "precache": "0", "ondemand": "0"},
+        )
+        await shared.sadd("services", "svc")
+        try:
+            for s in (a, b):
+                await s.setup()
+                s.start_loops()
+            for s in (a, b):
+                await s.replica.poll()
+
+            n = 6
+            for i in range(n):
+                await shared.set(f"account:acct-{i}", h(1000 + i))
+            hashes = [h(2000 + i) for i in range(n)]
+            # every replica hears every confirmation (production fanout)
+            for i, bh in enumerate(hashes):
+                for s in (a, b):
+                    await s.block_arrival_handler(bh, f"acct-{i}", h(1000 + i))
+
+            dispatched = a.precache.count("dispatch") + b.precache.count("dispatch")
+            gated = a.precache.count("not_owner") + b.precache.count("not_owner")
+            assert dispatched == n and gated == n
+            # and the gate routed each hash to its ring owner, not to a
+            # fixed replica
+            for bh in hashes:
+                owner = owner_of(bh, ["ra", "rb"])
+                owner_server = a if owner == "ra" else b
+                assert owner_server.admission.has_lease(bh), (bh, owner)
+
+        finally:
+            await a.close()
+            await b.close()
+
+    run(main())

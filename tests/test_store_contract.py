@@ -82,6 +82,26 @@ async def test_ttl_expiry_and_clear(s):
 
 
 @_parametrized
+async def test_getset_atomic_swap(s):
+    # The account-frontier fence (precache/pipeline.py): whichever caller's
+    # swap RETURNS a given old value is the exactly-one owner of retiring
+    # it — no two callers may see the same old frontier.
+    assert await s.getset("account:A", "f1") is None
+    assert await s.get("account:A") == "f1"
+    assert await s.getset("account:A", "f2") == "f1"
+    assert await s.getset("account:A", "f2") == "f2"  # same-hash race shape
+    assert await s.get("account:A") == "f2"
+    # expire applies to the NEW value
+    await s.getset("account:B", "v", expire=0.05)
+    await asyncio.sleep(0.08)
+    assert await s.get("account:B") is None
+    # an expired old value reads as absent, not as a stale frontier
+    await s.set("account:C", "old", expire=0.05)
+    await asyncio.sleep(0.08)
+    assert await s.getset("account:C", "new") is None
+
+
+@_parametrized
 async def test_setnx_winner_election(s):
     # Two results race for the same block's winner lock
     # (reference dpow_server.py:138).

@@ -14,11 +14,11 @@ waiver.
 Detection model (per function, one-level helper resolution like
 DPOW801): a Store READ (``get``/``hget``/``hgetall``/``smembers``/
 ``exists`` on a ``store``-named receiver) of a key classifiable into
-one of the shared prefixes (``replica:``, ``quota:``, ``fleet:``) —
-directly or via a same-class helper that performs such a read — followed
-later in the same function by a non-atomic Store WRITE (``set``/
-``hset``/``sadd``/``srem``) with a key of the SAME prefix, fires at the
-write. Key classification resolves literals, module constants, class
+one of the shared prefixes (``replica:``, ``quota:``, ``fleet:``,
+``account:``, ``precache:``) — directly or via a same-class helper that
+performs such a read — followed later in the same function by a
+non-atomic Store WRITE (``set``/``hset``/``sadd``/``srem``) with a key
+of the SAME prefix, fires at the write. Key classification resolves literals, module constants, class
 constants (``self.PREFIX``), leading-literal f-strings, and f-strings
 whose first placeholder is such a constant. ``replica/fence.py`` is the
 sanctioned fenced-write boundary and exempt.
@@ -45,7 +45,7 @@ CODE_RMW = "DPOW1005"
 FAMILIES = (("store-atomicity", (CODE_RMW,)),)
 
 #: the shared key spaces two processes may race on
-PREFIXES = ("replica:", "quota:", "fleet:")
+PREFIXES = ("replica:", "quota:", "fleet:", "account:", "precache:")
 
 READ_METHODS = ("get", "hget", "hgetall", "smembers", "exists")
 

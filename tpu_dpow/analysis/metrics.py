@@ -36,6 +36,7 @@ DOC_FILES = (
     "fleet.md",
     "replication.md",
     "loadgen.md",
+    "precache.md",
 )
 
 _KINDS = {"counter", "gauge", "histogram"}

@@ -62,6 +62,7 @@ INSTRUMENTED_PREFIXES = (
     "tpu_dpow/backend/jax_backend.py",
     "tpu_dpow/ops/control.py",
     "tpu_dpow/autoscale/",
+    "tpu_dpow/precache/",
 )
 
 
@@ -98,8 +99,8 @@ def add_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--san", action="store_true",
         help="after the static pass, replay the coalescing, fleet "
-        "re-cover, replica-takeover, device-fault and autoscale-drain "
-        "scenarios under the seeded interleaving perturber",
+        "re-cover, replica-takeover, device-fault, autoscale-drain and "
+        "precache scenarios under the seeded interleaving perturber",
     )
     p.add_argument(
         "--san_seeds", type=int,
@@ -869,12 +870,181 @@ async def scenario_autoscale(perturber: Perturber) -> None:
         await server.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario: precache evict vs on-demand arrival vs lease lapse vs shed
+# ---------------------------------------------------------------------------
+
+
+async def scenario_precache(perturber: Perturber) -> None:
+    """The population-scale precache subsystem (tpu_dpow/precache/) under
+    seed-shuffled races of everything that can touch one speculative
+    dispatch: a confirmation storm over more accounts than the cache
+    holds (capacity EVICTION + frontier-supersede), an ON-DEMAND request
+    arriving for a frontier the precacher may or may not have finished,
+    the admission LEASE lapsing mid-flight (clock advance past
+    precache_lease), and the autoscaler's SHED lever flipping on and back
+    off. Invariants: the on-demand request is served with valid work or
+    misses cleanly (timeout-class abort — never stranded); the cache
+    bound is never exceeded at any instant; once every dispatch resolves
+    no admission slot or precache lease is stranded and no pending entry
+    squats in the budget; side tables torn down."""
+    from ..server.app import WORK_PENDING
+    from ..server.exceptions import RequestTimeout, RetryRequest
+    from ..transport.mqtt_codec import encode_result_payload
+
+    rng = perturber.rng
+    capacity = rng.randint(2, 3)
+    server, store, clock = await _start_server(
+        perturber, fleet=False,
+        max_inflight_dispatches=4,
+        precache_cache_size=capacity,
+        precache_watermark=1.0,  # admission policy = beat-the-lowest at bound
+        precache_lease=5.0,
+        precache_window_fraction=1.0 if rng.random() < 0.5 else 0.5,
+    )
+    payout = _payout()
+    try:
+        # more known accounts than the cache holds: eviction pressure is
+        # structural, not incidental. Genesis frontiers make them known
+        # without debug mode, so the score policy is really in the loop.
+        accounts = [f"acct-{i}" for i in range(capacity + 2)]
+        genesis = {}
+        for i, acct in enumerate(accounts):
+            g = _scenario_hash(perturber.seed * 131 + i, "precache-genesis")
+            genesis[acct] = g
+            await store.set(f"account:{acct}", g)
+        hot = accounts[0]
+        c1 = _scenario_hash(perturber.seed * 7 + 1, "precache-hot")
+        c2 = _scenario_hash(perturber.seed * 7 + 2, "precache-hot")
+        confs = [(c1, hot, genesis[hot]), (c2, hot, c1)]
+        for i, acct in enumerate(accounts[1:], start=1):
+            confs.append((
+                _scenario_hash(perturber.seed * 11 + i, "precache-cold"),
+                acct, genesis[acct],
+            ))
+        if rng.random() < 0.5:
+            # a re-announce racing the original: the frontier fence
+            # (getset) must give exactly one caller the dispatch
+            confs.append((c2, hot, c1))
+        rng.shuffle(confs)
+        hashes = list({h for h, _, _ in confs})
+        works = {h: solve(h, EASY_DIFFICULTY) for h in hashes}
+
+        conf_tasks = []
+        for h, acct, prev in confs:
+            conf_tasks.append(asyncio.ensure_future(
+                server.block_arrival_handler(h, acct, prev)
+            ))
+            for _ in range(rng.randint(0, 3)):
+                await asyncio.sleep(0)
+        # the on-demand arrival races the speculative solves: a READY
+        # entry serves from the store, a pending one coalesces onto the
+        # in-flight dispatch, a refused/evicted one pays on-demand
+        h_req = rng.choice(hashes)
+        req = asyncio.ensure_future(server.service_handler(
+            {"user": "svc", "api_key": "secret", "hash": h_req, "timeout": 25}
+        ))
+        do_shed = rng.random() < 0.6
+        do_lapse = rng.random() < 0.6
+        shed_at = rng.randint(0, 40)
+        lift_at = shed_at + rng.randint(5, 40)
+        lapse_at = rng.randint(0, 60)
+        everyone = conf_tasks + [req]
+        for spin in range(2000):
+            if len(server.precache_cache) > capacity:
+                raise SanitizerFailure(
+                    f"cache bound exceeded: {len(server.precache_cache)} "
+                    f"entries in a capacity-{capacity} cache"
+                )
+            if do_shed and spin == shed_at:
+                await perturber.point("precache.shed")
+                server.apply_control({"precache_shed": True})
+            if do_shed and spin == lift_at:
+                server.apply_control({"precache_shed": False})
+            if do_lapse and spin == lapse_at:
+                # past precache_lease + the admission sweep interval: the
+                # poll loop lapses every unresolved speculative lease
+                await clock.advance(6.0)
+            if all(t.done() for t in everyone):
+                break
+            for h in hashes:
+                if await store.get(f"block:{h}") == WORK_PENDING:
+                    wt = await store.get(f"work-type:{h}") or "ondemand"
+                    await server.client_result_handler(
+                        f"result/{wt}",
+                        encode_result_payload(h, works[h], payout),
+                    )
+            await asyncio.sleep(0)
+        else:
+            stranded = [i for i, t in enumerate(everyone) if not t.done()]
+            stored = await store.get(f"block:{h_req}")
+            raise SanitizerFailure(
+                f"tasks {stranded} stranded across the precache races "
+                f"(store holds {stored!r} for the requested hash)"
+            )
+        for t in conf_tasks:
+            t.result()  # a confirmation must never raise out of the seam
+        r = (await asyncio.gather(req, return_exceptions=True))[0]
+        if r != {"work": works[h_req], "hash": h_req} and not isinstance(
+            r, (RetryRequest, RequestTimeout)
+        ):
+            raise SanitizerFailure(
+                f"on-demand request ended wrong: {r!r} — a precache hit "
+                "must serve and a miss must fail cleanly"
+            )
+        if do_shed:
+            server.apply_control({"precache_shed": False})
+        # drain every still-pending speculative dispatch, then lapse and
+        # reap whatever never resolved: the budget must not be squatted
+        for _ in range(1000):
+            pending = [
+                h for h in hashes
+                if await store.get(f"block:{h}") == WORK_PENDING
+            ]
+            if not pending:
+                break
+            for h in pending:
+                wt = await store.get(f"work-type:{h}") or "ondemand"
+                await server.client_result_handler(
+                    f"result/{wt}", encode_result_payload(h, works[h], payout)
+                )
+            await asyncio.sleep(0)
+        else:
+            raise SanitizerFailure("speculative dispatches never drained")
+        await _settle()
+        await clock.advance(6.0)
+        await _settle()
+        await server.precache.flush()
+        server.admission.poll()
+        server.precache.reap_lapsed()
+        for entry in server.precache_cache.entries():
+            if entry.state != "ready":
+                raise SanitizerFailure(
+                    f"entry {entry.block_hash} stranded {entry.state} in "
+                    "the budget after every dispatch resolved"
+                )
+        if server.admission.precache_inflight != 0:
+            raise SanitizerFailure(
+                f"{server.admission.precache_inflight} precache lease(s) "
+                "still hold window slots after every dispatch resolved"
+            )
+        if server.admission.window.inflight != 0:
+            raise SanitizerFailure(
+                f"window still holds {server.admission.window.inflight} "
+                "slot(s) after every dispatch resolved"
+            )
+        _check_teardown(server)
+    finally:
+        await server.close()
+
+
 SCENARIOS: Dict[str, Callable] = {
     "coalesce": scenario_coalesce,
     "fleet_recover": scenario_fleet_recover,
     "takeover": scenario_takeover,
     "devfault": scenario_devfault,
     "autoscale": scenario_autoscale,
+    "precache": scenario_precache,
 }
 
 

@@ -53,6 +53,13 @@ class Signals:
     replicas_live: float            # ring liveness (max across sources)
     sources_ok: int
     sources_total: int
+    # Fraction of requests completed IN THE WINDOW that were served from
+    # precached work (dpow_precache_requests_total deltas merged across
+    # sources; None = no classified request completed this window).
+    # Counter-delta, not the server's sliding-window gauge: the gauge's
+    # window and the poll cadence would otherwise double-smooth. Trailing
+    # + defaulted so pre-precache journals still from_dict cleanly.
+    precache_hit_ratio: Optional[float] = None
 
     def to_dict(self) -> dict:
         d = dict(self.__dict__)
@@ -96,6 +103,13 @@ def _latency_buckets(parsed: dict) -> Dict[float, float]:
     return out
 
 
+def _outcome_sum(parsed: dict, name: str, outcome: str) -> float:
+    return float(sum(
+        v for labels, v in parsed.get(name, [])
+        if labels.get("outcome") == outcome
+    ))
+
+
 def parse_metrics_page(text: str) -> dict:
     """A scraped page reduced to what the controller needs."""
     parsed = prom.parse_text(text)
@@ -106,6 +120,10 @@ def parse_metrics_page(text: str) -> dict:
         "capacity": _sum_series(parsed, "dpow_sched_window_capacity"),
         "coalesce": _sum_series(parsed, "dpow_coalesce_total"),
         "fleet_hashrate": _sum_series(parsed, "dpow_fleet_hashrate"),
+        "precache_hits": _outcome_sum(
+            parsed, "dpow_precache_requests_total", "hit"),
+        "precache_misses": _outcome_sum(
+            parsed, "dpow_precache_requests_total", "miss"),
         "replica_live": max(
             (v for _, v in parsed.get("dpow_replica_live", [])), default=0.0
         ),
@@ -136,6 +154,19 @@ def snapshot_page(snapshot: dict) -> dict:
         (v for v in live_fam.values() if isinstance(v, (int, float))),
         default=0.0,
     )
+    pre_fam = snapshot.get("dpow_precache_requests_total", {})
+    pre_labels = pre_fam.get("labels", [])
+    o_idx = pre_labels.index("outcome") if "outcome" in pre_labels else None
+    hits = misses = 0.0
+    if o_idx is not None:
+        for key, v in pre_fam.get("series", {}).items():
+            if not isinstance(v, (int, float)):
+                continue
+            outcome = key.split(",")[o_idx]
+            if outcome == "hit":
+                hits += v
+            elif outcome == "miss":
+                misses += v
     return {
         "latency_buckets": buckets,
         "queue_depth": total("dpow_sched_queue_depth"),
@@ -143,6 +174,8 @@ def snapshot_page(snapshot: dict) -> dict:
         "capacity": total("dpow_sched_window_capacity"),
         "coalesce": total("dpow_coalesce_total"),
         "fleet_hashrate": total("dpow_fleet_hashrate"),
+        "precache_hits": hits,
+        "precache_misses": misses,
         "replica_live": float(live),
     }
 
@@ -162,7 +195,7 @@ def _page_to_signals(
     last ``window`` seconds, not just this poll's — the smoothing the
     hysteresis streaks reason over."""
     merged_delta: Dict[float, float] = {}
-    coalesce_delta = 0.0
+    coalesce_delta = hit_delta = miss_delta = 0.0
     queue_depth = inflight = capacity = fleet = live = 0.0
     for page, state in zip(pages, states):
         if page is None:
@@ -178,6 +211,19 @@ def _page_to_signals(
         cur_coal = page["coalesce"]
         coalesce_delta += cur_coal - prev_coal if cur_coal >= prev_coal else cur_coal
         state.counters["coalesce"] = cur_coal
+        # precache yield: same reset-tolerant counter-delta fold (pages
+        # from pre-precache journals simply lack the keys)
+        for field_name, bucket in (
+            ("precache_hits", "hits"), ("precache_misses", "misses"),
+        ):
+            cur_v = page.get(field_name, 0.0)
+            prev_v = state.counters.get(field_name, 0.0)
+            d = cur_v - prev_v if cur_v >= prev_v else cur_v
+            state.counters[field_name] = cur_v
+            if bucket == "hits":
+                hit_delta += d
+            else:
+                miss_delta += d
         queue_depth += page["queue_depth"]
         inflight += page["inflight"]
         capacity += page["capacity"]
@@ -209,6 +255,10 @@ def _page_to_signals(
         replicas_live=live,
         sources_ok=ok,
         sources_total=total_sources,
+        precache_hit_ratio=(
+            hit_delta / (hit_delta + miss_delta)
+            if (hit_delta + miss_delta) > 0 else None
+        ),
     )
 
 

@@ -58,6 +58,10 @@ class FaultyStore(Store):
         await self._guard("setnx", key)
         return await self.inner.setnx(key, value, expire)
 
+    async def getset(self, key: str, value: str, expire: Optional[float] = None):
+        await self._guard("getset", key)
+        return await self.inner.getset(key, value, expire)
+
     async def delete(self, *keys: str) -> int:
         await self._guard("delete", keys[0] if keys else "")
         return await self.inner.delete(*keys)

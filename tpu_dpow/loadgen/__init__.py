@@ -21,7 +21,10 @@ This package is the open-loop replacement:
                 and same-hash coalescing), cancel rate, a per-request
                 timeout distribution, and a real quota identity (each
                 simulated service is registered in the store and metered
-                by tpu_dpow/sched/ like any paying customer);
+                by tpu_dpow/sched/ like any paying customer) — plus the
+                node-side workload: a Zipf-over-accounts block
+                confirmation stream whose frontiers chain per account and
+                feed back into the request stream (the precache coupling);
   recorder    — coordinated-omission-safe capture: every latency is
                 measured from the *intended* arrival time on the
                 injectable resilience.Clock, never from the moment the
@@ -53,7 +56,13 @@ from .arrival import (  # noqa: F401
     poisson_schedule,
     trace_schedule,
 )
-from .population import RequestSpec, ServicePopulation  # noqa: F401
+from .population import ConfirmSpec, RequestSpec, ServicePopulation  # noqa: F401
 from .recorder import FINE_BUCKETS, OpenLoopRecorder  # noqa: F401
-from .driver import HttpPostDriver, InprocDriver, OpenLoopDriver, WsDriver  # noqa: F401
+from .driver import (  # noqa: F401
+    ConfirmFeed,
+    HttpPostDriver,
+    InprocDriver,
+    OpenLoopDriver,
+    WsDriver,
+)
 from .responder import SyntheticResponder  # noqa: F401

@@ -140,6 +140,50 @@ class OpenLoopDriver:
             return "error"
 
 
+class ConfirmFeed:
+    """Open-loop block-confirmation stream: the node-websocket side of the
+    workload, paced exactly like the request side. Each schedule arrival
+    becomes one :meth:`ServicePopulation.confirm_spec` confirmation,
+    BROADCAST to every handler — in production every replica subscribes
+    the node's websocket and hears every confirmation; the ring-ownership
+    gate inside ``block_arrival_handler`` is what keeps exactly one of
+    them precaching, and that is precisely the behavior a multi-replica
+    capture must exercise rather than simulate away."""
+
+    def __init__(
+        self,
+        handlers,
+        population,
+        *,
+        clock: Optional[Clock] = None,
+    ):
+        self.handlers = (
+            list(handlers) if isinstance(handlers, (list, tuple)) else [handlers]
+        )
+        self.population = population
+        self.clock = clock or SystemClock()
+        self.issued = 0
+
+    async def run(self, schedule: Iterable[Arrival]) -> int:
+        start = self.clock.time()
+        for arrival in schedule:
+            due = start + arrival.t
+            delay = due - self.clock.time()
+            if delay > 0:
+                await self.clock.sleep(delay)
+            spec = self.population.confirm_spec(arrival)
+            for handler in self.handlers:
+                try:
+                    await handler(spec.hash, spec.account, spec.previous)
+                except Exception:
+                    logger.debug(
+                        "confirmation feed failed for %s", spec.account,
+                        exc_info=True,
+                    )
+            self.issued += 1
+        return self.issued
+
+
 # ---------------------------------------------------------------------------
 # HTTP POST face
 # ---------------------------------------------------------------------------

@@ -24,6 +24,18 @@ seed):
 A small ``hot_hash`` probability models the flash-crowd correlation that
 makes spikes coalescible: during a market move, MANY services re-request
 the SAME few frontiers.
+
+The population also synthesizes the OTHER side of the workload: the
+node's block-confirmation stream (:meth:`confirm_spec`). Confirmations
+draw from a Zipf over ``n_accounts`` accounts with the same exponent as
+the service curve — the population-scale shape the precache subsystem
+(tpu_dpow/precache/) exists for — and each account's confirmations CHAIN
+(every ConfirmSpec's ``previous`` is that account's last confirmed hash,
+exactly like a real Nano frontier). The two streams are coupled the way
+reality couples them: a confirmed frontier is pushed into the owning
+service's reuse pool and the hot set, so the request stream starts
+asking for exactly the hashes a good precacher would have pre-solved —
+which is what makes a measured hit ratio meaningful.
 """
 
 from __future__ import annotations
@@ -50,6 +62,17 @@ class RequestSpec:
     #: seconds after issue at which the client abandons the request
     #: (None = waits its timeout out like a well-behaved caller)
     cancel_after: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class ConfirmSpec:
+    """One node block confirmation the driver will feed the server."""
+
+    t: float
+    account: str
+    hash: str
+    #: the account's prior frontier (None only for a never-seen account)
+    previous: Optional[str]
 
 
 @dataclass(frozen=True)
@@ -82,6 +105,7 @@ class ServicePopulation:
         reuse_window: int = 8,
         hot_hash_prob: float = 0.02,
         hot_window: int = 4,
+        n_accounts: Optional[int] = None,
     ):
         if n_services < 1:
             raise ValueError("need at least one service")
@@ -117,6 +141,23 @@ class ServicePopulation:
         self._recent: dict = {}
         self._hot: Deque[str] = deque(maxlen=hot_window)
         self._reuse_window = reuse_window
+        # Confirmation-side population: a (possibly much larger) Zipf of
+        # accounts with the same exponent — n_accounts scales to millions
+        # because accounts are index-derived, never profiled. Account i
+        # belongs to service i % n_services, so the hot account head and
+        # the hot service head coincide (as they do in production: the
+        # busiest wallets belong to the busiest providers).
+        self.n_accounts = n_accounts if n_accounts is not None else n_services
+        if self.n_accounts < 1:
+            raise ValueError("need at least one account")
+        acc_cum: List[float] = []
+        acc_total = 0.0
+        for i in range(self.n_accounts):
+            acc_total += 1.0 / (i + 1) ** zipf_s
+            acc_cum.append(acc_total)
+        self._acc_cum = acc_cum
+        self._acc_total = acc_total
+        self._frontiers: dict = {}  # account -> last confirmed hash
 
     # -- request synthesis ---------------------------------------------
 
@@ -170,6 +211,36 @@ class ServicePopulation:
             cancel_after=cancel_after,
         )
 
+    # -- confirmation synthesis ----------------------------------------
+
+    def _pick_account(self) -> int:
+        r = self._rng.random() * self._acc_total
+        return min(bisect_right(self._acc_cum, r), self.n_accounts - 1)
+
+    def account_name(self, idx: int) -> str:
+        return f"acct-{idx:07d}"
+
+    def confirm_spec(self, arrival: Arrival) -> ConfirmSpec:
+        """Turn one schedule arrival into a block confirmation: a Zipf-
+        picked account's frontier advances by one fresh hash, chained to
+        its previous frontier. The new frontier is pushed into the owning
+        service's reuse pool and the hot set, so subsequent request specs
+        ask for it — the precache-hit coupling."""
+        idx = self._pick_account()
+        account = self.account_name(idx)
+        block_hash = self._fresh_hash()
+        previous = self._frontiers.get(account)
+        self._frontiers[account] = block_hash
+        profile = self.profiles[idx % len(self.profiles)]
+        recent: Deque[str] = self._recent.setdefault(
+            profile.name, deque(maxlen=self._reuse_window)
+        )
+        recent.append(block_hash)
+        self._hot.append(block_hash)
+        return ConfirmSpec(
+            t=arrival.t, account=account, hash=block_hash, previous=previous
+        )
+
     # -- store registration --------------------------------------------
 
     async def seed_store(self, store) -> int:
@@ -192,3 +263,23 @@ class ServicePopulation:
             )
             await store.sadd("services", p.name)
         return len(self.profiles)
+
+    async def seed_accounts(
+        self, store, *, limit: Optional[int] = None, expire=None
+    ) -> int:
+        """Make the hottest ``limit`` accounts KNOWN to the server before
+        the run (a genesis frontier under ``account:{name}``), the way a
+        long-lived deployment has already tracked its regulars. Without
+        this every confirmation of a fresh population is an
+        unknown_account and a debug-mode run would be the only way to
+        exercise precache — which bypasses the score policy this seeding
+        exists to measure. The tail past ``limit`` stays unknown, as the
+        tail does in production."""
+        count = min(limit if limit is not None else self.n_accounts,
+                    self.n_accounts)
+        for i in range(count):
+            account = self.account_name(i)
+            genesis = f"{i:064X}"
+            await store.set(f"account:{account}", genesis, expire)
+            self._frontiers.setdefault(account, genesis)
+        return count

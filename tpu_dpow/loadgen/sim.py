@@ -21,8 +21,15 @@ Faithfully modeled, because they change the controller's job:
     ``spawn_delay`` (the real process fork + setup + ring join cost);
   * drain-before-retire — a retiring replica stops accepting, finishes
     its queue, then leaves (the actuator's contract);
-  * precache background load — a utilization tax on every slot while
-    precache admission is open; the controller's shed lever removes it.
+  * precache — modeled as BOTH sides of the real trade, calibrated from
+    a live capture: while precache admission is open, ``precache_util``
+    of each replica's window is held by speculative leases (fewer
+    on-demand slots) and ``precache_hit`` of arrivals are served from
+    already-solved frontiers at store-hit cost (skipping dispatch
+    entirely). The controller's shed lever frees the slots and, with no
+    new speculative solves, zeroes the hit stream — so the sim
+    reproduces the real lever's shape: shedding buys window capacity
+    now at the price of longer service per request later.
 
 Not modeled: the fleet_horizon lever (a worker-fleet effect the sim's
 single synthetic responder tier has no analogue for) — the controller
@@ -75,7 +82,13 @@ class SimParams:
     service_sigma: float = 0.35
     service_floor: float = 0.01
     store_hit_s: float = 0.004      # served-from-store round trip
-    precache_util: float = 0.25     # slot tax while precache admission is open
+    # window fraction held by precache leases while admission is open
+    # (calibrate from dpow_sched_inflight's precache share in a live run)
+    precache_util: float = 0.25
+    # P(arrival's frontier was already speculatively solved) while
+    # precache is open — served at store_hit_s, no dispatch (calibrate
+    # from the live dpow_precache_hit_ratio)
+    precache_hit: float = 0.0
     spawn_delay: float = 3.0        # process start + ring join
     solved_lru: int = 50000         # recent solved hashes (store-hit window)
 
@@ -98,6 +111,7 @@ class SimOutcome:
     decisions: int = 0
     coalesced: int = 0
     store_hits: int = 0
+    precache_hits: int = 0
     peak_replicas: int = 0
 
 
@@ -139,6 +153,9 @@ class ClusterSim:
         self._pending: Dict[str, int] = {}   # hash -> waiters riding one slot
         self._solved: "dict" = {}            # bounded LRU of solved hashes
         self._recent_lat: Deque[Tuple[float, float]] = deque()
+        # (t, was_precache_hit) per classified arrival — the windowed
+        # hit-ratio signal, mirroring the real counter-delta fold
+        self._recent_pre: Deque[Tuple[float, bool]] = deque()
         self.out = SimOutcome()
         self._replica_marks: List[dict] = []
 
@@ -193,9 +210,18 @@ class ClusterSim:
         s = self.p.service_median * math.exp(
             self.rng.gauss(0.0, self.p.service_sigma)
         )
-        if self.precache_open and self.p.precache_util > 0:
-            s /= max(1e-6, 1.0 - self.p.precache_util)
         return max(self.p.service_floor, s)
+
+    def _window_now(self) -> int:
+        """On-demand slots per replica RIGHT NOW: while precache admission
+        is open its leases hold ``precache_util`` of the window (the real
+        window counts precache leases in inflight); shedding returns the
+        full window to on-demand work."""
+        if self.precache_open and self.p.precache_util > 0:
+            return max(
+                1, int(round(self.p.window * (1.0 - self.p.precache_util)))
+            )
+        return self.p.window
 
     def _note_solved(self, block_hash: str) -> None:
         self._solved[block_hash] = True
@@ -221,8 +247,18 @@ class ClusterSim:
             self._recent_lat.popleft()
         lats = sorted(lat for _, lat in self._recent_lat)
         p95 = lats[min(int(0.95 * len(lats)), len(lats) - 1)] if lats else None
+        while self._recent_pre and self._recent_pre[0][0] < now - self.signal_window:
+            self._recent_pre.popleft()
+        pre_ratio = (
+            sum(1 for _, hit in self._recent_pre if hit) / len(self._recent_pre)
+            if self._recent_pre else None
+        )
         accepting = self._accepting()
         inflight = sum(r.busy for r in self._replicas.values())
+        # precache leases hold real window slots on the live server and
+        # count in dpow_sched_inflight; mirror that so the controller's
+        # occupancy signal sees the same saturation either way
+        inflight += (self.p.window - self._window_now()) * len(accepting)
         capacity = max(1, len(accepting)) * self.p.window
         return Signals(
             t=now,
@@ -237,6 +273,7 @@ class ClusterSim:
             replicas_live=float(len(accepting)),
             sources_ok=len(accepting),
             sources_total=len(self._replicas),
+            precache_hit_ratio=pre_ratio,
         )
 
     # -- the run ---------------------------------------------------------
@@ -317,6 +354,21 @@ class ClusterSim:
                 ("ok", spec.intended_t, None, None),
             )
             return
+        # precache hit: the account's frontier was speculatively solved
+        # before the request arrived — answered at store cost, no slot.
+        # Only while precache is open: the shed lever stops new
+        # speculative solves, so fresh frontiers stop being pre-answered
+        # (hits collapse to zero, exactly the live flash-crowd shape).
+        if self.p.precache_hit > 0:
+            hit = self.precache_open and self.rng.random() < self.p.precache_hit
+            self._recent_pre.append((self.clock.now, hit))
+            if hit:
+                self.out.precache_hits += 1
+                self._push(
+                    self.clock.now + self.p.store_hit_s, "complete",
+                    ("ok", spec.intended_t, None, None),
+                )
+                return
         if spec.hash in self._pending:
             # same-hash coalesce: ride the in-flight dispatch's slot
             self._pending[spec.hash] += 1
@@ -331,7 +383,7 @@ class ClusterSim:
             self._finish(spec.intended_t, "busy")
             return
         r = accepting[next(self._rr) % len(accepting)]
-        if r.busy < self.p.window:
+        if r.busy < self._window_now():
             self._start_service(r, spec)
         elif len(r.queue) < self.p.queue_limit:
             r.queue.append((self.clock.now, spec))
@@ -363,7 +415,7 @@ class ClusterSim:
             return
         r.busy -= 1
         # pull the queue, expiring waiters whose patience ran out
-        while r.queue and r.busy < self.p.window:
+        while r.queue and r.busy < self._window_now():
             queued_at, spec = r.queue.popleft()
             if self.clock.now - queued_at > spec.timeout:
                 self._finish(spec.intended_t, "timeout")

@@ -42,10 +42,17 @@ class AdmissionController:
         quota_burst: float = 20.0,
         quota_hard: bool = False,
         precache_lease: float = 30.0,
+        precache_window_fraction: float = 1.0,
         busy_retry_after: float = 1.0,
     ):
         self.clock = clock or SystemClock()
         self.quota_hard = quota_hard
+        # Rate shaping for speculative work: precache may hold at most this
+        # fraction of a bounded window's slots, so a confirmation storm can
+        # never crowd on-demand admission below (1 - fraction) of capacity.
+        # 1.0 (or an unbounded window) disables the carve-out — the seed
+        # behavior, where only the shed-on-full rule protects on-demand.
+        self.precache_fraction = min(max(precache_window_fraction, 0.0), 1.0)
         self.ledger = QuotaLedger(
             store, rate=quota_rate, burst=quota_burst, clock=self.clock
         )
@@ -185,10 +192,35 @@ class AdmissionController:
             # (the admitted/rejected/shed sum stays exhaustive) and refuse
             self._event("shed", ticket)
             return None
+        if (
+            self.window.capacity > 0
+            and self.precache_fraction < 1.0
+            and self.precache_inflight
+            >= max(1, int(self.precache_fraction * self.window.capacity))
+        ):
+            # Precache's window share is spent: shed exactly as a full
+            # window would (same counter, same "next confirmation retries"
+            # contract) while on-demand admission still sees free slots.
+            self._event("shed", ticket)
+            return None
         if self.window.try_acquire(ticket):
             self._leases[key] = ticket
             return ticket
         return None
+
+    @property
+    def precache_inflight(self) -> int:
+        """Window slots currently held by live precache leases."""
+        return sum(
+            1 for t in self._leases.values() if self.window.holds(t)
+        )
+
+    def has_lease(self, key: str) -> bool:
+        """Is a precache lease for this block hash still holding a slot?
+        (False once the lease lapsed or a result released it — the
+        precache pipeline's reaper keys its cache eviction on this.)"""
+        ticket = self._leases.get(key)
+        return ticket is not None and self.window.holds(ticket)
 
     def release(self, ticket: Ticket) -> None:
         # Identity-guarded: an on-demand dispatch and a precache lease can

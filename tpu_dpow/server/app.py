@@ -38,6 +38,7 @@ from ..fleet import (
     WorkerRegistry,
 )
 from ..models import DifficultyModel, WorkType
+from ..precache import AccountScorer, PrecacheCache, PrecachePipeline
 from ..replica import ReplicaCoordinator, StaleEpoch, dispatch_topic, result_lane
 from ..resilience import DispatchSupervisor, SystemClock
 from ..sched import AdmissionController, Busy
@@ -126,6 +127,7 @@ class DpowServer:
             quota_burst=config.quota_burst,
             quota_hard=config.quota_hard,
             precache_lease=config.precache_lease,
+            precache_window_fraction=config.precache_window_fraction,
             busy_retry_after=config.busy_retry_after,
         )
         # Window ticket per dispatched hash; lives and dies with the
@@ -272,6 +274,41 @@ class DpowServer:
             "1 while this replica refuses new service work pending "
             "retirement (the /control/ drain lever)")
         self._m_draining.set(0.0)
+        # Population-scale precache (tpu_dpow/precache/, docs/precache.md):
+        # block confirmations are scored per account, admitted into a
+        # BOUNDED priority cache of speculative solves, and dispatched
+        # rate-shaped through the admission controller — replacing the
+        # reference's flat "every known account's every confirmation burns
+        # a dispatch" path (reference dpow_server.py:170-206).
+        self.precache_scorer = AccountScorer(
+            store,
+            clock=self.clock,
+            half_life=config.precache_score_half_life,
+            max_accounts=config.precache_max_accounts,
+        )
+        self.precache_cache = PrecacheCache(
+            capacity=config.precache_cache_size,
+            watermark=config.precache_watermark,
+            min_score=config.precache_min_score,
+            clock=self.clock,
+        )
+        self.precache = PrecachePipeline(
+            store,
+            self.admission,
+            self.fleet,
+            self._tracer,
+            self.precache_scorer,
+            self.precache_cache,
+            base_difficulty=config.base_difficulty,
+            debug=config.debug,
+            account_expiry=config.account_expiry,
+            block_expiry=config.block_expiry,
+            batch_interval=config.precache_batch_interval,
+            batch_size=config.precache_batch_size,
+            poll_interval=config.admission_poll_interval,
+            clock=self.clock,
+            retire_cb=self._precache_retired,
+        )
 
     # ------------------------------------------------------------------
     # runtime control (POST /control/ on the upcheck face)
@@ -349,6 +386,11 @@ class DpowServer:
             await self.transport.subscribe(
                 f"result/{self.replica.replica_id}/#", qos=QOS_1
             )
+        if self.config.enable_precache:
+            # Rehydrate the hot head of the account-activity table so a
+            # restarted server resumes preferring the same accounts it
+            # had learned (wall-decayed for the downtime).
+            await self.precache_scorer.load()
         self._started = True
 
     def start_loops(self) -> None:
@@ -364,6 +406,9 @@ class DpowServer:
                 self.admission.run(self.config.admission_poll_interval)
             )
         )
+        if self.config.enable_precache:
+            # Batch flusher + lease reaper for the precache pipeline.
+            self._tasks.append(asyncio.ensure_future(self.precache.run()))
         if self.config.fleet:
             self._tasks.append(asyncio.ensure_future(self._fleet_poll_loop()))
         if self.replica is not None:
@@ -1166,6 +1211,9 @@ class DpowServer:
         # the winning result is what releases it (on-demand slots release
         # with their dispatch state instead — release_key no-ops there).
         self.admission.release_key(block_hash)
+        # The speculative solve landed: flip the cache entry to ready so
+        # the budget's hit accounting can tell solved from still-pending.
+        self.precache.on_result(block_hash, work_type)
 
         future = self.work_futures.get(block_hash)
         if future is not None and not future.done():
@@ -1223,77 +1271,24 @@ class DpowServer:
         self, block_hash: str, account: str, previous: Optional[str]
     ) -> None:
         self.last_block = self.clock.time()
-        should_precache = self.config.debug
-        previous_exists = False
-        old_frontier = await self.store.get(f"account:{account}")
-
-        if old_frontier:
-            if old_frontier == block_hash:
-                return  # duplicate confirmation
-            should_precache = True
-        elif previous is not None:
-            previous_exists = await self.store.exists(f"block:{previous}")
-            if previous_exists:
-                should_precache = True
-
-        if not should_precache or not self.config.enable_precache:
+        if not self.config.enable_precache:
             return
-
-        # Admission gate (sched/): precache is speculative and first in
-        # the load-shedding order — a full dispatch window sheds it here,
-        # never queues it ahead of waiting on-demand work. The next
-        # confirmation for this account simply retries.
-        if self.admission.try_acquire_precache(
-            block_hash, difficulty=self.config.base_difficulty
-        ) is None:
-            logger.debug("precache for %s shed: dispatch window full", block_hash)
-            return
-
-        # Precache traces start at the queue stage: there is no service
-        # accept, the block arrival IS the request.
-        trace_id = self._tracer.begin(block_hash, stage="queue")
-        self._m_precache.inc()
-        aws = [
-            self.store.set(f"account:{account}", block_hash, expire=self.config.account_expiry),
-            self.store.set(f"block:{block_hash}", WORK_PENDING, expire=self.config.block_expiry),
-            self.store.set(
-                f"work-type:{block_hash}", WorkType.PRECACHE.value, expire=self.config.block_expiry
-            ),
-            self.fleet.publish_work(
-                block_hash, self.config.base_difficulty,
-                WorkType.PRECACHE.value, trace_id,
-            ),
-        ]
-        if old_frontier:
-            # Retire the superseded frontier completely: its winner lock and
-            # work-type must go with the work, or a later on-demand request
-            # for that hash dispatches fine but every result is discarded at
-            # the still-held setnx lock until its TTL (reference parity:
-            # dpow_server.py:191-205 only deletes the work key, but its lock
-            # has a 5 s TTL and the reference accepts that stall window —
-            # here the retirement is made atomic instead). A retired hash
-            # will never see its result: its precache lease goes with it.
-            self.admission.release_key(old_frontier)
-            self.fleet.forget(old_frontier)
-            aws.append(
-                self.store.delete(
-                    f"block:{old_frontier}",
-                    f"block-lock:{old_frontier}",
-                    f"work-type:{old_frontier}",
-                )
-            )
-        elif previous_exists:
-            self.admission.release_key(previous)
-            self.fleet.forget(previous)
-            aws.append(
-                self.store.delete(
-                    f"block:{previous}",
-                    f"block-lock:{previous}",
-                    f"work-type:{previous}",
-                )
-            )
-        await asyncio.gather(*aws)
-        self._tracer.mark(trace_id, "publish")
+        if self.replica is not None:
+            # Ring-ownership gate: every replica hears every node
+            # confirmation, and without this each of N replicas would
+            # score, admit, and DISPATCH the same frontier — N window
+            # slots and N fleet publishes for one block, plus an N-way
+            # race on the frontier swap. Route by block hash exactly as
+            # the on-demand path does (_dispatch_ondemand): the one owner
+            # precaches; a dead owner's confirmations are simply lost
+            # until the ring heals, which is the correct price for
+            # SPECULATIVE work (the next confirmation, or an on-demand
+            # request, regenerates it).
+            owner = self.replica.route(block_hash)
+            if owner != self.replica.replica_id:
+                self.precache.note_verdict("not_owner")
+                return
+        await self.precache.on_confirmation(block_hash, account, previous)
 
     async def block_arrival_ws_handler(self, data: dict) -> None:
         try:
@@ -1314,6 +1309,17 @@ class DpowServer:
         """Per-hash lock serializing every block-difficulty write/publish
         (dispatcher and raisers) for one in-flight dispatch."""
         return self._difficulty_locks.setdefault(block_hash, asyncio.Lock())
+
+    def _precache_retired(self, block_hash: str) -> None:
+        """Precache retire hook (capacity evict / frontier supersede / shed
+        unwind): the dispatch will never see its result. Cancelling the
+        hash's future sends every coalesced on-demand waiter down the
+        cancelled-under-us path in _dispatch_ondemand — store re-check,
+        then a clean RetryRequest — instead of stranding them for their
+        whole timeout on work nobody will deliver."""
+        fut = self.work_futures.get(block_hash)
+        if fut is not None and not fut.done():
+            fut.cancel()
 
     def _drop_dispatch_state(self, block_hash: str) -> None:
         """Remove ALL per-dispatch side tables for a hash. Single place on
@@ -1394,6 +1400,10 @@ class DpowServer:
             self._m_request_seconds.observe(
                 self.clock.time() - t0, served["work_type"]
             )
+            # Precache yield accounting: a request served from speculative
+            # work is a hit, an on-demand solve is a miss, a request that
+            # died unresolved is neither (it never reached the decision).
+            self.precache.note_request(served["work_type"])
 
     async def _service_request(self, data: dict, served: dict) -> dict:
         if self.draining:
@@ -1460,6 +1470,8 @@ class DpowServer:
                     # outlive the reset, or the fresh on-demand result would
                     # be discarded and the request would time out.
                     await self.store.delete(f"block-lock:{block_hash}")
+                    # The cached solve bought nothing: free its budget slot.
+                    self.precache.on_stale(block_hash)
                     logger.info(
                         "forcing ondemand for %s: precached value too weak", block_hash
                     )
@@ -1762,6 +1774,22 @@ class DpowServer:
                         )
                         self.supervisor.dispatched(block_hash)
                         self._tracer.mark_hash(block_hash, "publish")
+                    # Void-dispatch re-check: a precache retire (frontier
+                    # supersede / capacity evict) can delete `block:` in
+                    # the window between _service_request's WORK_PENDING
+                    # write and the future install above — its retire hook
+                    # found no future to cancel yet, and the result
+                    # handler drops every result for a hash whose key is
+                    # gone, so the waiters would strand for their whole
+                    # timeout. One store read per dispatch closes the
+                    # window: key gone ⇒ dispatch void ⇒ cancel, and every
+                    # waiter fails over through the cancelled-under-us
+                    # store re-check below.
+                    if (
+                        await self.store.get(f"block:{block_hash}") is None
+                        and not created.done()
+                    ):
+                        created.cancel()
                 except BaseException:
                     # A failed dispatch must not leave a never-resolved
                     # future that later requests for this hash would

@@ -83,6 +83,32 @@ class ServerConfig:
     # result before its lease lapses (dead publishes must not pin the
     # window shut).
     precache_lease: float = 30.0
+    # -- population-scale precache (tpu_dpow/precache/, docs/precache.md)
+    # Bounded budget of speculatively solved frontiers: at most this many
+    # precached blocks live at once; admission is by account activity
+    # score and at the bound the lowest-scored entry is evicted.
+    precache_cache_size: int = 512
+    # Above this fraction of the cache bound, a newcomer must out-score
+    # the lowest-scored resident to be admitted (below it, clearing
+    # precache_min_score suffices).
+    precache_watermark: float = 0.9
+    # Activity-score floor for admission while the cache is slack
+    # (0 = any known account qualifies, the seed policy).
+    precache_min_score: float = 0.0
+    # Half-life (s) of the per-account confirmation-activity EMA: an
+    # account confirming once per half-life holds a score near 1.
+    precache_score_half_life: float = 900.0
+    # Cardinality bound on the in-memory score table (watermark-pruned;
+    # only the hot head is persisted across restarts).
+    precache_max_accounts: int = 65536
+    # Share of a bounded admission window precache leases may hold
+    # (1.0 = no carve-out beyond shed-on-full, the seed behavior).
+    precache_window_fraction: float = 1.0
+    # > 0 fuses precache publishes into one batched flush per this many
+    # seconds (store writes stay immediate); 0 publishes per-confirmation.
+    precache_batch_interval: float = 0.0
+    # Flush early once this many publishes are queued (batch mode only).
+    precache_batch_size: int = 16
     # Retry-After hint (seconds) carried by shed/rejected responses.
     busy_retry_after: float = 1.0
     admission_poll_interval: float = 0.5
@@ -186,6 +212,39 @@ def parse_args(argv=None) -> ServerConfig:
     p.add_argument("--precache_lease", type=float, default=c.precache_lease,
                    help="seconds a precache dispatch holds a window slot "
                    "with no worker result before the lease lapses")
+    p.add_argument("--precache_cache_size", type=int,
+                   default=c.precache_cache_size,
+                   help="bound on live precached frontiers; at the bound "
+                   "the lowest-scored entry is evicted for a hotter one")
+    p.add_argument("--precache_watermark", type=float,
+                   default=c.precache_watermark,
+                   help="cache-occupancy fraction above which admission "
+                   "requires out-scoring the lowest cached entry")
+    p.add_argument("--precache_min_score", type=float,
+                   default=c.precache_min_score,
+                   help="account activity score required for precache "
+                   "admission while the cache is slack (0 = any known "
+                   "account, the reference policy)")
+    p.add_argument("--precache_score_half_life", type=float,
+                   default=c.precache_score_half_life,
+                   help="half-life (s) of the per-account confirmation-"
+                   "activity score")
+    p.add_argument("--precache_max_accounts", type=int,
+                   default=c.precache_max_accounts,
+                   help="in-memory account-score table bound (watermark-"
+                   "pruned; only the hot head persists across restarts)")
+    p.add_argument("--precache_window_fraction", type=float,
+                   default=c.precache_window_fraction,
+                   help="max share of a bounded admission window precache "
+                   "leases may hold (1.0 = no carve-out)")
+    p.add_argument("--precache_batch_interval", type=float,
+                   default=c.precache_batch_interval,
+                   help="fuse precache publishes into one flush per this "
+                   "many seconds (0 = publish per confirmation)")
+    p.add_argument("--precache_batch_size", type=int,
+                   default=c.precache_batch_size,
+                   help="flush a fused precache batch early at this many "
+                   "queued publishes")
     p.add_argument("--busy_retry_after", type=float, default=c.busy_retry_after,
                    help="Retry-After hint (s) on shed/rejected responses")
     p.add_argument("--admission_poll_interval", type=float,

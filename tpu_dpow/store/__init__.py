@@ -62,6 +62,15 @@ class Store(abc.ABC):
         redis_db.py:60-70 / dpow_server.py:138). Returns True if we won."""
 
     @abc.abstractmethod
+    async def getset(self, key: str, value: str, expire: Optional[float] = None) -> Optional[str]:
+        """Atomic swap: set the key and return the PREVIOUS live value
+        (None if absent/expired). The account-frontier advance rests on
+        this (server block_arrival path): get-then-set across awaits is a
+        cross-replica lost-update window, and whichever replica's swap
+        returns a given old frontier is the exactly-one owner of retiring
+        it."""
+
+    @abc.abstractmethod
     async def delete(self, *keys: str) -> int: ...
 
     @abc.abstractmethod
@@ -161,6 +170,18 @@ class MemoryStore(Store):
             self._data[key] = str(value)
             self._set_expiry(key, expire)
             return True
+
+    async def getset(self, key: str, value: str, expire: Optional[float] = None) -> Optional[str]:
+        async with self._lock:
+            old = None
+            if self._alive(key):
+                prior = self._data[key]
+                if not isinstance(prior, str):
+                    raise TypeError(f"{key} holds {type(prior).__name__}, not string")
+                old = prior
+            self._data[key] = str(value)
+            self._set_expiry(key, expire)
+            return old
 
     async def delete(self, *keys: str) -> int:
         removed = 0

@@ -242,6 +242,9 @@ class DegradedStore(Store):
     async def setnx(self, key: str, value: str, expire=None) -> bool:
         return await self._call("setnx", (key, value, expire), mutating=True)
 
+    async def getset(self, key: str, value: str, expire=None):
+        return await self._call("getset", (key, value, expire), mutating=True)
+
     async def delete(self, *keys: str) -> int:
         return await self._call("delete", keys, mutating=True)
 

@@ -98,6 +98,13 @@ class RedisStore(Store):
         ok = await self._c(self._redis.set(key, value, nx=True, px=self._px(expire)))
         return bool(ok)
 
+    async def getset(self, key: str, value: str, expire: Optional[float] = None) -> Optional[str]:
+        # SET ... GET (redis >= 6.2) is the atomic swap; the deprecated
+        # GETSET command has no TTL argument.
+        return await self._c(
+            self._redis.set(key, value, px=self._px(expire), get=True)
+        )
+
     async def delete(self, *keys: str) -> int:
         return await self._c(self._redis.delete(*keys))
 

@@ -198,6 +198,32 @@ class SqliteStore(Store):
         self._commit()
         return True
 
+    async def getset(self, key: str, value: str, expire: Optional[float] = None) -> Optional[str]:
+        self._begin_immediate()
+        try:
+            self._expect_type(key, "kv")
+            # Liveness in SQL, not _get_row: its lazy expired-row DELETE
+            # commits, ending the IMMEDIATE transaction mid-swap (the same
+            # hazard setnx documents). An expired row reads as None and
+            # the upsert below overwrites it either way.
+            row = self._db.execute(
+                "SELECT value FROM kv WHERE key = ? AND "
+                "(expires_at IS NULL OR expires_at > ?) LIMIT 1",
+                (key, time.time()),
+            ).fetchone()
+            old = row[0] if row else None
+            self._db.execute(
+                "INSERT INTO kv (key, value, expires_at) VALUES (?, ?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value = excluded.value, "
+                "expires_at = excluded.expires_at",
+                (key, value, self._deadline(expire)),
+            )
+        except BaseException:
+            self._db.rollback()
+            raise
+        self._commit()
+        return old
+
     async def delete(self, *keys: str) -> int:
         n = 0
         for key in keys:
