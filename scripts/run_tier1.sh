@@ -93,6 +93,16 @@ if timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
 else
     echo "PRECACHE=fail"
 fi
+# Resource-lifetime coverage at a glance (ISSUE 20): the LeakLedger unit
+# pins plus the DPOW1101-1104 fixture/acceptance tests (including the
+# pinned strip-the-release property). Collection only — the family
+# itself is folded into the DPOWLINT families=N denominator below, and
+# the runtime invariant into the LEDGER= line under dpowsan.
+LIFETIME=$(timeout -k 5 60 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_ledger.py tests/test_analysis.py \
+    -k 'lifetime or ledger or transfer or double_release or waiver' \
+    --collect-only -q -p no:cacheprovider 2>/dev/null | grep -c '::' || true)
+echo "LIFETIME=${LIFETIME}"
 # dpowlint headline (ISSUE 5, families since ISSUE 15): the repo's own
 # invariant checkers — clean or the escaped-finding count, plus the
 # active checker-family count parsed from the run's own summary line, so
@@ -143,5 +153,15 @@ else
         # broke (crash/timeout); never report that as near-clean
         echo "DPOWSAN=error (rc=$sanrc)"
     fi
+fi
+# LeakLedger headline (ISSUE 20): the zero-outstanding-at-teardown
+# invariant across every dpowsan run above — clean, or the summed
+# outstanding resource count (the report prints it either way).
+if printf '%s\n' "$DPOWSAN_OUT" | grep -q 'dpowsan: ledger clean'; then
+    echo "LEDGER=clean"
+else
+    NOUT=$(printf '%s\n' "$DPOWSAN_OUT" \
+        | grep -o 'ledger [0-9]* outstanding' | grep -o '[0-9]*' | head -1)
+    echo "LEDGER=${NOUT:-error}"
 fi
 exit "$rc"

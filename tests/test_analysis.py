@@ -23,6 +23,7 @@ from tpu_dpow.analysis import (
     clock,
     concurrency,
     flags,
+    lifetime,
     locks,
     metrics,
     replica_keys,
@@ -982,11 +983,18 @@ def test_sanitizer_same_seed_same_interleaving_trace():
     assert b.ok and a.trace_digest == b.trace_digest
     c = sanitizer.run_seed("coalesce", 6)
     assert c.ok and c.trace_digest != a.trace_digest
+    # ISSUE 20: the ledger trace rides the same contract — same seed,
+    # same acquire/release interleaving — and a clean run holds zero
+    # outstanding resources at teardown.
+    assert a.outstanding == 0 and a.ledger_digest
+    assert a.ledger_digest == b.ledger_digest
     # the replicated takeover scenario rides the same contract
     t1 = sanitizer.run_seed("takeover", 5)
     t2 = sanitizer.run_seed("takeover", 5)
     assert t1.ok, t1.error
     assert t2.ok and t1.trace_digest == t2.trace_digest
+    assert t1.outstanding == 0
+    assert t1.ledger_digest == t2.ledger_digest
 
 
 def test_sanitizer_annotates_static_findings():
@@ -1002,6 +1010,13 @@ def test_sanitizer_annotates_static_findings():
         "tpu_dpow/backend/jax_backend.py", 60, "DPOW1001", "m6"
     )
     f_fence_cold = Finding("tpu_dpow/client/app.py", 70, "DPOW1001", "m7")
+    # ISSUE 20: DPOW1101 lifetime candidates fold in the same way — the
+    # scenarios drive every LeakLedger seam, so a leak the checker
+    # claims is either reproduced (teardown outstanding != 0 fails the
+    # seed with a traceback through the leaking file) or not.
+    f_life_hit = Finding("tpu_dpow/server/app.py", 80, "DPOW1101", "m8")
+    f_life_hot = Finding("tpu_dpow/sched/window.py", 90, "DPOW1101", "m9")
+    f_life_cold = Finding("tpu_dpow/client/app.py", 95, "DPOW1101", "m10")
     report = sanitizer.SanitizerReport(
         runs=[
             sanitizer.SeedRun(
@@ -1013,7 +1028,7 @@ def test_sanitizer_annotates_static_findings():
     )
     verdicts = sanitizer.annotate(
         [f_hit, f_hot, f_cold, f_other, f_fence_hit, f_fence_hot,
-         f_fence_cold],
+         f_fence_cold, f_life_hit, f_life_hot, f_life_cold],
         report,
     )
     assert verdicts[f_hit.key()] == sanitizer.CONFIRMED
@@ -1023,6 +1038,9 @@ def test_sanitizer_annotates_static_findings():
     assert verdicts[f_fence_hit.key()] == sanitizer.CONFIRMED
     assert verdicts[f_fence_hot.key()] == sanitizer.NOT_REPRODUCED
     assert verdicts[f_fence_cold.key()] == sanitizer.UNEXERCISED
+    assert verdicts[f_life_hit.key()] == sanitizer.CONFIRMED
+    assert verdicts[f_life_hot.key()] == sanitizer.NOT_REPRODUCED
+    assert verdicts[f_life_cold.key()] == sanitizer.UNEXERCISED
 
 
 # ---------------------------------------------------------------------------
@@ -1127,6 +1145,7 @@ def test_cli_entrypoint(args, rc):
         for code in (
             "DPOW101", "DPOW801", "DPOW802", "DPOW803", "DPOW002",
             "DPOW1001", "DPOW1002", "DPOW1003", "DPOW1004", "DPOW1005",
+            "DPOW1101", "DPOW1102", "DPOW1103", "DPOW1104",
         ):
             assert code in proc.stdout
     else:
@@ -1690,8 +1709,11 @@ def test_family_registry_covers_every_catalogue_code():
     # one family per new ISSUE 15 checker, all registered
     assert {"DPOW1001", "DPOW1002", "DPOW1003", "DPOW1004", "DPOW1005",
             "DPOW002"} <= set(all_codes)
+    # the ISSUE 20 lifetime family rides the same registry
+    assert {"DPOW1101", "DPOW1102", "DPOW1103", "DPOW1104"} <= set(all_codes)
     assert tracing.check in CHECKERS and atomicity.check in CHECKERS
-    assert len(FAMILIES) == 16
+    assert lifetime.check in CHECKERS
+    assert len(FAMILIES) == 17
     # derivation: FAMILIES is exactly the meta-family plus each
     # registered checker's own module declaration, in registration order
     derived = [("stale-waiver", ("DPOW002",))]
@@ -1969,3 +1991,383 @@ def test_traced_leak_taints_through_annassign_and_augassign(tmp_path):
     found = tracing.check_traced_leak(project)
     assert codes(found) == ["DPOW1002"]
     assert sorted(f.line for f in found) == [8, 12]
+
+# ---------------------------------------------------------------------------
+# DPOW1101-1104 resource lifetime (lifetime.py)
+# ---------------------------------------------------------------------------
+
+
+def _ownership_table(**overrides):
+    """A docs/resilience.md ownership table generated FROM the
+    declaration, so the fixture stays correct when RESOURCE_TABLE
+    grows; overrides (kind → row string) inject specific drift."""
+    lines = [
+        "## Resource ownership",
+        "",
+        "| kind | acquire | release | coverage | meaning |",
+        "|---|---|---|---|---|",
+    ]
+    for r in lifetime.RESOURCE_TABLE:
+        if r.kind in overrides:
+            row = overrides[r.kind]
+            if row is not None:
+                lines.append(row)
+            continue
+        acq = ", ".join(f"`{a}`" for a in r.acquire) or "install"
+        rel = ", ".join(
+            f"`{x}`" for x in (r.release + r.keyed_release)
+        ) or "teardown"
+        lines.append(f"| `{r.kind}` | {acq} | {rel} | {r.coverage} | x |")
+    return "\n".join(lines) + "\n"
+
+
+def test_lifetime_fires_on_await_between_acquire_and_release(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def dispatch(admission, h):\n"
+                "    ticket = await admission.acquire_dispatch('s', h)\n"
+                "    await publish(h)\n"
+                "    admission.release(ticket)\n"
+            )
+        },
+    )
+    found = lifetime.check_release_paths(project)
+    assert codes(found) == ["DPOW1101"]
+    assert found[0].line == 2 and "ticket" in found[0].message
+
+
+def test_lifetime_fires_on_discarded_handle_and_exit_paths(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def fire_and_forget(admission, h):\n"
+                "    await admission.acquire_dispatch('s', h)\n"
+                "\n"
+                "def early_exit(ctl, cb, flag):\n"
+                "    slot = ctl.register(cb)\n"
+                "    if flag:\n"
+                "        poll(slot)\n"
+                "    return None\n"
+            )
+        },
+    )
+    found = lifetime.check_release_paths(project)
+    assert [f.code for f in found] == ["DPOW1101", "DPOW1101"]
+    assert "discards its handle" in found[0].message
+
+
+def test_lifetime_quiet_on_try_finally_and_transfer_and_return(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def guarded(self, h):\n"
+                "    ticket = None\n"
+                "    try:\n"
+                "        ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "        await publish(h)\n"
+                "    finally:\n"
+                "        if ticket is not None:\n"
+                "            self.admission.release(ticket)\n"
+                "\n"
+                "async def transferred(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self._dispatch_tickets[h] = ticket\n"
+                "    ticket = None\n"
+                "    await publish(h)\n"
+                "\n"
+                "def minted(ctl, cb):\n"
+                "    slot = ctl.register(cb)\n"
+                "    return slot\n"
+                "\n"
+                "def into_record(self, ctl, cb):\n"
+                "    slot = ctl.register(cb)\n"
+                "    rec = _Launch(fut=self._submit(slot), slot=slot)\n"
+                "    return rec\n"
+                "\n"
+                "def lease_lapses(self, admission, key):\n"
+                "    lease = admission.try_acquire_precache(key)\n"
+                "    if lease is None:\n"
+                "        return False\n"
+                "    self.kick(key)\n"
+                "    return True\n"
+                "\n"
+                "def foreign_register(registry, worker):\n"
+                "    rid = registry.register(worker)\n"
+                "    return None\n"
+            )
+        },
+    )
+    assert lifetime.check_release_paths(project) == []
+
+
+def test_lifetime_claim_handler_protection(tmp_path):
+    ok = (
+        "async def adopt(self, store, dead_id, dead_epoch):\n"
+        "    won = await claim_adoption(store, dead_id, dead_epoch)\n"
+        "    if not won:\n"
+        "        return\n"
+        "    try:\n"
+        "        await self._pass(dead_id)\n"
+        "    except Exception:\n"
+        "        await release_adoption(store, dead_id, dead_epoch)\n"
+        "        raise\n"
+        "    except BaseException:\n"
+        "        LEDGER.discharge('claim', (dead_id, dead_epoch), op='lapse')\n"
+        "        raise\n"
+    )
+    bad = (
+        "async def adopt(self, store, dead_id, dead_epoch):\n"
+        "    won = await claim_adoption(store, dead_id, dead_epoch)\n"
+        "    if not won:\n"
+        "        return\n"
+        "    await self._pass(dead_id)\n"
+    )
+    assert lifetime.check_release_paths(
+        make_project(tmp_path / "ok", {"tpu_dpow/a.py": ok})
+    ) == []
+    found = lifetime.check_release_paths(
+        make_project(tmp_path / "bad", {"tpu_dpow/a.py": bad})
+    )
+    assert codes(found) == ["DPOW1101"] and "won" in found[0].message
+
+
+def test_lifetime_acceptance_stripping_the_release_refires(tmp_path):
+    """The pinned delete-the-release property: a fixture copy of the
+    PR-8 dispatcher prologue (server/app.py) is clean as shipped, and
+    removing the ticket release from its finally re-fires DPOW1101 —
+    the checker actually guards the fix, not just the fixture."""
+    prologue = (
+        "async def _dispatch(self, service, block_hash):\n"
+        "    ticket = None\n"
+        "    gate = None\n"
+        "    try:\n"
+        "        ticket = await self.admission.acquire_dispatch(\n"
+        "            service, block_hash)\n"
+        "        gate = self._make_gate(block_hash)\n"
+        "        await self._publish_work(block_hash)\n"
+        "        return await self._await_result(block_hash)\n"
+        "    finally:\n"
+        "        if gate is not None and self._dispatch_gates.get(\n"
+        "                block_hash) is gate:\n"
+        "            del self._dispatch_gates[block_hash]\n"
+        "        if ticket is not None:\n"
+        "            self.admission.release(ticket)\n"
+    )
+    assert lifetime.check_release_paths(
+        make_project(tmp_path / "ok", {"tpu_dpow/server/app.py": prologue})
+    ) == []
+    stripped = prologue.replace(
+        "        if ticket is not None:\n"
+        "            self.admission.release(ticket)\n",
+        "",
+    )
+    assert stripped != prologue
+    found = lifetime.check_release_paths(
+        make_project(tmp_path / "bad", {"tpu_dpow/server/app.py": stripped})
+    )
+    assert codes(found) == ["DPOW1101"]
+    assert found[0].path == "tpu_dpow/server/app.py"
+
+
+def test_lifetime_helper_resolution_in_finally(tmp_path):
+    """One-level helper resolution (the DPOW801 idiom): the finally
+    releases through _drop_dispatch_state, whose body holds the actual
+    release call."""
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "class S:\n"
+                "    async def dispatch(self, h):\n"
+                "        ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "        try:\n"
+                "            self._dispatch_tickets[h] = ticket\n"
+                "            ticket = None\n"
+                "            await publish(h)\n"
+                "        finally:\n"
+                "            self._drop(h)\n"
+                "\n"
+                "    def _drop(self, h):\n"
+                "        t = self._dispatch_tickets.pop(h, None)\n"
+                "        if t is not None:\n"
+                "            self.admission.release(t)\n"
+            )
+        },
+    )
+    assert lifetime.check_release_paths(project) == []
+
+
+def test_transfer_fires_without_neutralize_and_on_undeclared_store(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def unneutralized(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self._dispatch_tickets[h] = ticket\n"
+                "    return None\n"
+                "\n"
+                "async def undeclared(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self._my_stash[h] = ticket\n"
+                "    ticket = None\n"
+                "    return None\n"
+            )
+        },
+    )
+    found = lifetime.check_transfers(project)
+    assert [f.code for f in found] == ["DPOW1102", "DPOW1102"]
+    assert "neutraliz" in found[0].message
+    assert "undeclared" in found[1].message
+
+
+def test_transfer_quiet_on_recorded_and_neutralized_store(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def ok(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self._dispatch_tickets[h] = ticket\n"
+                "    ticket = None\n"
+                "    return None\n"
+            )
+        },
+    )
+    assert lifetime.check_transfers(project) == []
+
+
+def test_double_release_and_use_after_release(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def twice(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self.admission.release(ticket)\n"
+                "    self.admission.release(ticket)\n"
+                "\n"
+                "async def used(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self.admission.release(ticket)\n"
+                "    publish(ticket)\n"
+            )
+        },
+    )
+    found = lifetime.check_double_release(project)
+    assert [f.code for f in found] == ["DPOW1103", "DPOW1103"]
+    assert "released twice" in found[0].message
+    assert "used after its release" in found[1].message
+
+
+def test_double_release_quiet_on_neutralize_and_branches(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/a.py": (
+                "async def rearmed(self, h):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    self.admission.release(ticket)\n"
+                "    ticket = None\n"
+                "    publish(ticket)\n"
+                "\n"
+                "async def branch_guarded(self, h, flag):\n"
+                "    ticket = await self.admission.acquire_dispatch('s', h)\n"
+                "    if flag:\n"
+                "        self.admission.release(ticket)\n"
+                "    else:\n"
+                "        self.admission.release(ticket)\n"
+            )
+        },
+    )
+    assert lifetime.check_double_release(project) == []
+
+
+def test_doc_table_cross_check_both_directions(tmp_path):
+    pkg = {"tpu_dpow/a.py": "x = 1\n"}
+    # correct, generated-from-declaration table → silent
+    project = make_project(
+        tmp_path / "ok", dict(pkg, **{"docs/resilience.md": _ownership_table()})
+    )
+    assert lifetime.check_doc_table(project) == []
+    # a dropped kind row, a coverage mismatch, a stale row, a duplicate
+    drift = _ownership_table(
+        ticket=None,
+        slot="| `slot` | `register` | `release` | ledger | x |",
+    ) + (
+        "| `zombie` | `grab` | `drop` | static+ledger | x |\n"
+        "| `lease` | `try_acquire_precache` | `release`, `release_key` "
+        "| static+ledger | duplicate |\n"
+    )
+    project = make_project(
+        tmp_path / "bad", dict(pkg, **{"docs/resilience.md": drift})
+    )
+    found = lifetime.check_doc_table(project)
+    assert codes(found) == ["DPOW1104"]
+    messages = " / ".join(f.message for f in found)
+    assert "ticket" in messages and "no row" in messages
+    assert "coverage column" in messages
+    assert "zombie" in messages
+    assert "two ownership rows" in messages
+    # docs-free fixture trees are exempt (no table to cross-check)
+    assert lifetime.check_doc_table(make_project(tmp_path / "no", pkg)) == []
+
+
+def test_waiver_without_justification_is_a_finding(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "tpu_dpow/w.py": (
+                "import time\n\n"
+                "def stamp():\n"
+                "    return time.time()  # dpowlint: disable=DPOW101\n"
+            )
+        },
+    )
+    found = run_all(project, [clock.check])
+    assert codes(found) == ["DPOW002"]
+    assert "no written justification" in found[0].message
+
+
+def test_waiver_budget_drift_is_a_finding(tmp_path):
+    src = {
+        "tpu_dpow/w.py": (
+            "import time\n\n"
+            "def stamp():\n"
+            "    return time.time()  # dpowlint: disable=DPOW101 — wall on purpose\n"
+        )
+    }
+    # matching record → silent; drifted record → DPOW002 at the record
+    ok = make_project(
+        tmp_path / "ok",
+        dict(src, **{"tpu_dpow/analysis/waivers.txt": "# budget\n1\n"}),
+    )
+    assert run_all(ok, [clock.check]) == []
+    bad = make_project(
+        tmp_path / "bad",
+        dict(src, **{"tpu_dpow/analysis/waivers.txt": "# budget\n0\n"}),
+    )
+    found = run_all(bad, [clock.check])
+    assert codes(found) == ["DPOW002"]
+    assert found[0].path.endswith("waivers.txt")
+    assert "grew to 1" in found[0].message
+    # absent record → unenforced (fixture projects stay quiet)
+    assert run_all(make_project(tmp_path / "none", src), [clock.check]) == []
+
+
+def test_waiver_budget_matches_the_committed_record():
+    project = Project(REPO_ROOT)
+    total = sum(len(s.waivers) for s in project.sources())
+    recorded = None
+    for raw in (
+        REPO_ROOT / "tpu_dpow" / "analysis" / "waivers.txt"
+    ).read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if line and not line.startswith("#"):
+            recorded = int(line)
+            break
+    assert recorded == total

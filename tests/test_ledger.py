@@ -1,0 +1,86 @@
+"""LeakLedger (tpu_dpow/obs/ledger.py): the runtime half of the DPOW11xx
+resource-lifetime contract. Count discipline, unmatched-discharge
+accounting, the per-reset alias map that keeps traces deterministic, and
+the dpow_resource_outstanding gauge mirror."""
+
+from tpu_dpow import obs
+from tpu_dpow.obs.ledger import GAUGE_NAME, LeakLedger
+
+
+def _gauge_series():
+    fam = obs.snapshot().get(GAUGE_NAME)
+    return fam["series"] if fam else {}
+
+
+def test_acquire_discharge_balance_and_gauge():
+    led = LeakLedger()
+    led.acquire("ticket", "a")
+    led.acquire("ticket", "b")
+    led.acquire("slot", 7)
+    assert led.outstanding() == {"ticket": 2, "slot": 1}
+    assert _gauge_series()["ticket"] == 2.0
+    assert led.discharge("ticket", "a") is True
+    assert led.discharge("slot", 7, op="lapse") is True
+    assert led.outstanding() == {"ticket": 1}
+    assert _gauge_series()["ticket"] == 1.0
+    assert _gauge_series()["slot"] == 0.0
+    assert led.outstanding_keys() == ("ticket#2",)
+
+
+def test_unmatched_discharge_is_non_fatal_and_never_negative():
+    """Idempotent releases (the DPOW1004 belt-and-suspenders slot
+    release) are legal: the ledger records them, never raises, and the
+    count floors at zero."""
+    led = LeakLedger()
+    assert led.discharge("slot", 1) is False
+    led.acquire("slot", 1)
+    assert led.discharge("slot", 1) is True
+    assert led.discharge("slot", 1) is False
+    assert led.outstanding() == {}
+    assert [e for e in led.trace() if e.startswith("unmatched")] == [
+        "unmatched-release slot#1",
+        "unmatched-release slot#1",
+    ]
+
+
+def test_transfer_is_count_neutral_and_traced():
+    led = LeakLedger()
+    led.acquire("ticket", "t")
+    led.transfer("ticket", "t", note="dispatch-table")
+    assert led.outstanding() == {"ticket": 1}
+    assert "transfer ticket#1 dispatch-table" in led.trace()
+    led.discharge("ticket", "t")
+    assert led.outstanding() == {}
+
+
+def test_trace_digest_depends_on_order_not_raw_keys():
+    """Raw keys may be identity objects or process-global counters; the
+    alias map assigns kind#N in first-use order per reset, so two runs
+    with the same event ORDER digest identically whatever the keys."""
+    a, b = LeakLedger(), LeakLedger()
+    ka, kb = object(), object()  # distinct identities
+    for led, key in ((a, ka), (b, kb)):
+        led.acquire("ticket", key)
+        led.discharge("ticket", key)
+        led.acquire("lease", (key, 1))
+        led.discharge("lease", (key, 1), op="lapse")
+    assert a.trace_digest() == b.trace_digest()
+    c = LeakLedger()
+    c.acquire("lease", 1)  # different order → different digest
+    c.discharge("lease", 1, op="lapse")
+    c.acquire("ticket", 2)
+    c.discharge("ticket", 2)
+    assert c.trace_digest() != a.trace_digest()
+
+
+def test_reset_clears_state_and_zeroes_gauges():
+    led = LeakLedger()
+    led.acquire("claim", ("r1", 3))
+    assert led.outstanding() == {"claim": 1}
+    led.reset()
+    assert led.outstanding() == {}
+    assert led.trace() == ()
+    assert _gauge_series().get("claim") == 0.0
+    # aliases restart from #1 after a reset (per-reset determinism)
+    led.acquire("claim", ("other", 9))
+    assert led.outstanding_keys() == ("claim#1",)

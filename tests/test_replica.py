@@ -848,6 +848,65 @@ def test_adopter_crash_mid_takeover_reopens_election_and_rejournals():
     run(main())
 
 
+def test_cancelled_adoption_discharges_its_claim_in_the_ledger():
+    """ISSUE 20 regression (the runtime half of DPOW1101): an adopter
+    torn down mid-pass deliberately leaves the STORE claim to its TTL —
+    that re-opened election IS the crash recovery — but the
+    process-local LeakLedger must still see the abandonment. Pre-fix,
+    the cancelled poll task left the claim registered forever and the
+    dpowsan zero-outstanding teardown invariant read every perturbed
+    takeover as a leak."""
+
+    async def main():
+        obs.reset()
+        obs.LEDGER.reset()
+        clock = FakeClock()
+        broker = Broker()
+        store = MemoryStore(clock=clock.time, shared=True)
+        await register_service(store)
+        a = await start_replica(broker, store, clock, "ra")
+        b = await start_replica(broker, store, clock, "rb")
+        try:
+            for s in (a, b):
+                await s.replica.poll()
+            await settle()
+            h = hash_owned_by("rb", ["ra", "rb"])
+            req = asyncio.ensure_future(a.service_handler(
+                {"user": "svc", "api_key": "secret", "hash": h, "timeout": 30}
+            ))
+            await settle(200)
+            assert {rh for rh, _ in await fence.read_dispatches(store, "rb")} \
+                == {h}
+            await b.crash()
+            await a.replica.poll()
+            await clock.advance(3.0)
+            entered = asyncio.Event()
+
+            async def wedged_cb(block_hash, record, dead_id):
+                entered.set()
+                await asyncio.get_running_loop().create_future()  # parked
+
+            real_cb = a.replica._adopt_cb
+            a.replica._adopt_cb = wedged_cb
+            dying_poll = asyncio.ensure_future(a.replica.poll())
+            await asyncio.wait_for(entered.wait(), 5)
+            # mid-pass the claim is a live, ledger-visible resource…
+            assert obs.LEDGER.outstanding().get("claim", 0) == 1
+            dying_poll.cancel()
+            await asyncio.gather(dying_poll, return_exceptions=True)
+            # …and the cancelled adopter discharged it on the way out
+            # (op=lapse: the store claim stays for the TTL re-open)
+            assert obs.LEDGER.outstanding().get("claim", 0) == 0
+            assert "lapse claim#1" in obs.LEDGER.trace()
+            req.cancel()
+            await asyncio.gather(req, return_exceptions=True)
+        finally:
+            for s in (a, b):
+                await s.close()
+
+    run(main())
+
+
 def test_raised_request_on_dead_owner_retargets_locally():
     """Post-review regression: a raised-difficulty request joining a
     FORWARDED hash whose ring owner has since died must re-target from

@@ -20,6 +20,11 @@ project-wide conventions that nothing enforced mechanically until now:
     analysis/tracing.py) and no load-then-save RMW on shared store keys
     (DPOW1005, analysis/atomicity.py) — is exactly what generic linters
     cannot see;
+  * every revocable resource — admission tickets, precache leases,
+    control slots, adoption claims — must be released on ALL paths,
+    transfers of ownership must be recorded, and nothing may release
+    twice or use a released handle (DPOW11xx, analysis/lifetime.py;
+    runtime-confirmed by the obs.LeakLedger under dpowsan);
   * an inline waiver that suppresses nothing is itself a finding
     (DPOW002): stale justifications read as live contracts in review.
 
@@ -44,6 +49,7 @@ from . import (  # noqa: F401
     clock,
     concurrency,
     flags,
+    lifetime,
     locks,
     metrics,
     replica_keys,
@@ -69,6 +75,7 @@ _CHECKER_MODULES = (
     replica_keys,
     tracing,
     atomicity,
+    lifetime,
 )
 
 #: checker registry (one ``check(project)`` per module)

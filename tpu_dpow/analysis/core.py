@@ -19,8 +19,10 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 #: comment syntax: ``# dpowlint: disable=DPOW101[,DPOW201] — justification``
 #: A waiver applies to its own line and to the line directly below it (so a
-#: standalone comment can sit above a long statement).
-WAIVER_RE = re.compile(r"#\s*dpowlint:\s*disable=([A-Z0-9,\s]+)")
+#: standalone comment can sit above a long statement). The justification is
+#: REQUIRED: a suppression nobody explained is unreviewable, and the meta
+#: pass (DPOW002) flags waivers whose trailing text is empty.
+WAIVER_RE = re.compile(r"#\s*dpowlint:\s*disable=([A-Z0-9,\s]+)(?:[—–:-]+\s*(.*))?")
 
 
 @dataclass(frozen=True)
@@ -57,6 +59,9 @@ class SourceFile:
         self._nodes: Optional[List[ast.AST]] = None
         self._aliases: Optional[Dict[str, str]] = None
         self.waivers: Dict[int, Set[str]] = {}
+        #: line → the waiver's trailing justification text ("" when the
+        #: author wrote none — the meta pass flags those)
+        self.waiver_notes: Dict[int, str] = {}
         try:
             tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
             for tok in tokens:
@@ -65,7 +70,11 @@ class SourceFile:
                 m = WAIVER_RE.search(tok.string)
                 if m:
                     codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
-                    self.waivers.setdefault(tok.start[0], set()).update(codes)
+                    ln = tok.start[0]
+                    self.waivers.setdefault(ln, set()).update(codes)
+                    note = (m.group(2) or "").strip()
+                    if note or ln not in self.waiver_notes:
+                        self.waiver_notes[ln] = note
         except tokenize.TokenError:
             pass
 
@@ -302,6 +311,78 @@ def _stale_waiver_findings(
     return out
 
 
+#: recorded inline-waiver budget, sibling of baseline.txt. The file holds
+#: the TOTAL number of inline waiver lines across the scanned package;
+#: when present, any drift between the live count and the record is a
+#: DPOW002 finding — so adding a waiver forces the author to (a) write a
+#: justification on the line and (b) bump the budget in the same change,
+#: making suppression growth reviewable instead of silent. Absent file =
+#: unenforced (fixture projects in tests are unaffected).
+WAIVER_BUDGET_FILE = "waivers.txt"
+
+
+def _waiver_discipline_findings(project: Project) -> List[Finding]:
+    """DPOW002 for (a) waivers with no written justification and (b) a
+    live waiver count that drifted from the recorded budget."""
+    out: List[Finding] = []
+    total = 0
+    for src in project.sources():
+        for ln in sorted(src.waivers):
+            total += 1
+            if not src.waiver_notes.get(ln, ""):
+                out.append(
+                    Finding(
+                        src.rel,
+                        ln,
+                        CODE_STALE_WAIVER,
+                        "waiver carries no written justification — every "
+                        "suppression must say why ('# dpowlint: "
+                        "disable=CODE — reason'); an unexplained waiver "
+                        "is unreviewable",
+                    )
+                )
+    budget_path = (
+        project.root / project.package_dir / "analysis" / WAIVER_BUDGET_FILE
+    )
+    if not budget_path.exists():
+        return out
+    rel = budget_path.relative_to(project.root).as_posix()
+    recorded: Optional[int] = None
+    for raw in budget_path.read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if line and not line.startswith("#"):
+            try:
+                recorded = int(line)
+            except ValueError:
+                recorded = None
+            break
+    if recorded is None:
+        out.append(
+            Finding(
+                rel,
+                1,
+                CODE_STALE_WAIVER,
+                "waiver budget file is unparseable: the first "
+                "non-comment line must be the total inline-waiver count",
+            )
+        )
+    elif total != recorded:
+        verb = "grew" if total > recorded else "shrank"
+        out.append(
+            Finding(
+                rel,
+                1,
+                CODE_STALE_WAIVER,
+                f"inline waiver count {verb} to {total} but the recorded "
+                f"budget is {recorded} — a new waiver needs a written "
+                "justification AND a budget bump in the same change "
+                "(a removed one, the matching decrement), so suppression "
+                "growth stays reviewable",
+            )
+        )
+    return out
+
+
 def run_all(project: Project, checkers=None, known_codes=None) -> List[Finding]:
     """Every checker over the project; inline-waived findings removed
     (each suppression is ACCOUNTED: a waiver that earns nothing, or names
@@ -333,7 +414,9 @@ def run_all(project: Project, checkers=None, known_codes=None) -> List[Finding]:
             if src is not None and _consume_waiver(src, f, consumed):
                 continue
             out.append(f)
-    for f in _stale_waiver_findings(project, consumed, known_codes, emittable):
+    meta = _stale_waiver_findings(project, consumed, known_codes, emittable)
+    meta += _waiver_discipline_findings(project)
+    for f in meta:
         src = by_rel.get(f.path)
         # Only an EXPLICIT DPOW002 co-waiver may silence the meta-pass —
         # a blanket ALL must not suppress its own staleness finding.

@@ -1061,6 +1061,13 @@ class SeedRun:
     trace_digest: str
     error: str = ""
     tb_paths: Tuple[str, ...] = ()
+    #: resources the LeakLedger still held at teardown (0 on a clean run
+    #: — the DPOW11xx zero-outstanding invariant, obs/ledger.py)
+    outstanding: int = 0
+    #: order-sensitive digest of the ledger's acquire/release trace; the
+    #: same seed must reproduce it exactly (pinned for the event-loop
+    #: deterministic scenarios in tests/test_analysis.py)
+    ledger_digest: str = ""
 
 
 @dataclass
@@ -1074,6 +1081,13 @@ class SanitizerReport:
     @property
     def seeds(self) -> int:
         return len({r.seed for r in self.runs})
+
+    @property
+    def ledger_outstanding(self) -> int:
+        """Total resources the LeakLedger held at teardown, summed over
+        every run (0 = the zero-outstanding invariant held everywhere;
+        the ``LEDGER=`` headline in scripts/run_tier1.sh)."""
+        return sum(r.outstanding for r in self.runs)
 
     def render(self) -> str:
         lines = []
@@ -1100,13 +1114,30 @@ class SanitizerReport:
                 f"dpowsan: clean ({len(self.runs)} runs, {self.seeds} seeds "
                 "per scenario)"
             )
+        outstanding = self.ledger_outstanding
+        lines.append(
+            "dpowsan: ledger "
+            + ("clean (0 outstanding)" if outstanding == 0
+               else f"{outstanding} outstanding resource(s) at teardown")
+        )
         return "\n".join(lines)
 
 
 def run_seed(scenario_name: str, seed: int) -> SeedRun:
-    """One reproducible scenario run under one seed."""
+    """One reproducible scenario run under one seed.
+
+    Besides the scenario's own asserts, every run carries the DPOW11xx
+    runtime invariant: the LeakLedger (obs/ledger.py) is reset before the
+    scenario and must read ZERO outstanding resources — tickets, leases,
+    slots, claims, gates, futures, origin entries, bg tasks — after it,
+    i.e. every acquire the run performed was discharged on some path the
+    seed exercised. A nonzero ledger is a leak the static DPOW1101 pass
+    reasons about, caught live."""
+    from ..obs.ledger import LEDGER
+
     perturber = Perturber(seed)
     scenario = SCENARIOS[scenario_name]
+    LEDGER.reset()
     try:
         asyncio.run(asyncio.wait_for(scenario(perturber), timeout=120))
     except Exception as e:
@@ -1121,8 +1152,28 @@ def run_seed(scenario_name: str, seed: int) -> SeedRun:
         return SeedRun(
             scenario_name, seed, False, perturber.digest(),
             error=tb.strip().splitlines()[-1] + f"\n{tb}", tb_paths=paths,
+            outstanding=sum(LEDGER.outstanding().values()),
+            ledger_digest=LEDGER.trace_digest(),
         )
-    return SeedRun(scenario_name, seed, True, perturber.digest())
+    leaked = LEDGER.outstanding()
+    if leaked:
+        detail = ", ".join(
+            f"{kind}={count}" for kind, count in sorted(leaked.items())
+        )
+        keys = ", ".join(LEDGER.outstanding_keys())
+        return SeedRun(
+            scenario_name, seed, False, perturber.digest(),
+            error=(
+                f"LeakLedger: {sum(leaked.values())} resource(s) still "
+                f"outstanding at teardown ({detail}) — leaked: {keys}"
+            ),
+            outstanding=sum(leaked.values()),
+            ledger_digest=LEDGER.trace_digest(),
+        )
+    return SeedRun(
+        scenario_name, seed, True, perturber.digest(),
+        ledger_digest=LEDGER.trace_digest(),
+    )
 
 
 def run_seeds(
@@ -1147,10 +1198,12 @@ UNEXERCISED = "unexercised"
 
 
 #: the static race classes dpowsan's scenarios can exercise: DPOW801
-#: check-then-act candidates and DPOW1001 epoch-fence candidates (the
+#: check-then-act candidates, DPOW1001 epoch-fence candidates (the
 #: device-fault and takeover scenarios drive exactly the stale-epoch
-#: apply paths the fence checker reasons about).
-ANNOTATED_CODES = ("DPOW801", "DPOW1001")
+#: apply paths the fence checker reasons about), and DPOW1101
+#: release-on-all-paths candidates (the LeakLedger's zero-outstanding
+#: teardown invariant is the runtime twin of that static judgment).
+ANNOTATED_CODES = ("DPOW801", "DPOW1001", "DPOW1101")
 
 
 def annotate(findings, report: SanitizerReport) -> Dict[str, str]:

@@ -35,6 +35,7 @@ from .registry import (  # noqa: F401
     Registry,
     get_registry,
 )
+from .ledger import LEDGER, LeakLedger, get_ledger  # noqa: F401
 from .trace import STAGES, Tracer, get_tracer, is_trace_id, new_trace_id  # noqa: F401
 from .prom import add_metrics_route, histogram_quantile, parse_text, render  # noqa: F401
 

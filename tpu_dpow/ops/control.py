@@ -51,6 +51,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs.ledger import LEDGER
+
 #: control word layout, one row per batch lane (uint32[n_dev, B, CTRL_WORDS])
 IDX_FLAGS = 0  #: bitmask of pending commands
 IDX_SEQ = 1  #: command generation; device applies raise/rebase once per seq
@@ -417,13 +419,19 @@ def register(control: LaunchControl) -> int:
     with _slots_lock:
         slot = next(_slot_ids)
         _slots[slot] = control
-        return slot
+    LEDGER.acquire("slot", slot)
+    return slot
 
 
 def release(slot: int) -> None:
-    """Drop a slot: late polls from a straggler device read all-zeros."""
+    """Drop a slot: late polls from a straggler device read all-zeros.
+    Idempotent — only the pop that actually removes the slot discharges
+    the ledger, so the engine's belt-and-suspenders double releases
+    (DPOW1004 waivers in backend/jax_backend.py) stay count-neutral."""
     with _slots_lock:
-        _slots.pop(slot, None)
+        dropped = _slots.pop(slot, None) is not None
+    if dropped:
+        LEDGER.discharge("slot", slot)
 
 
 def poll_slot(slot, dev, k, done) -> np.ndarray:

@@ -288,6 +288,40 @@ class ReplicaCoordinator:
         )
         if not won:
             return  # another replica is (or was) the adopter
+        try:
+            # dpowlint: disable=DPOW801 — the adoption setnx above is the real election (one winner per death event); the pass's membership-set mutations are idempotent under it
+            await self._adopt_pass(dead_id, dead_epoch)
+        except Exception:
+            # Crashed mid-pass (store hiccup, logic error) while HOLDING
+            # the claim: re-open the election NOW instead of stranding
+            # the remaining journal records until the claim TTL expires —
+            # the next claimant (us on the next poll, or any peer)
+            # re-adopts only what remains. Same reasoning as the
+            # leftovers branch inside _adopt_pass.
+            # dpowlint: disable=DPOW801 — same setnx serialization; the incomplete-marker add is idempotent
+            self._adoption_incomplete.add(dead_id)
+            await fence.release_adoption(self.store, dead_id, dead_epoch)
+            raise
+        except BaseException:
+            # Torn down mid-pass (poll-task cancel at close(), or a
+            # genuine adopter death simulated by cancel in tests): the
+            # STORE claim is deliberately left to its TTL — that
+            # re-opened election IS the designed crash recovery, and
+            # releasing it here would let a zombie of this process mask
+            # the adopter-crash path. The process-local LeakLedger still
+            # records the abandonment (no awaits on this path — it must
+            # survive GeneratorExit): this incarnation no longer owns a
+            # claim it will never finish.
+            obs.LEDGER.discharge(
+                "claim", (dead_id, int(dead_epoch)), op="lapse"
+            )
+            raise
+
+    async def _adopt_pass(self, dead_id: str, dead_epoch: int) -> None:
+        """One claimed adoption pass: fence, drain the journal, then
+        either re-open the election (leftovers) or retire the member
+        record. The CALLER holds the adoption claim and re-opens the
+        election if this pass dies with it held."""
         logger.warning(
             "replica %s adopting dead peer %s (epoch %d)",
             self.replica_id, dead_id, dead_epoch,
@@ -300,7 +334,6 @@ class ReplicaCoordinator:
         # deleting the record up front dropped the id from every view and
         # orphaned them forever.
         await fence.raise_fence(self.store, dead_id, dead_epoch + 1)
-        # dpowlint: disable=DPOW801 — the adoption setnx above is the real election (one winner per death event); a duplicate add here is idempotent
         self.adopted_from.add(dead_id)
         adopted = 0
         seen: Set[str] = set()
@@ -355,7 +388,6 @@ class ReplicaCoordinator:
             # election NOW — the next poll (ours or a peer's) re-claims
             # and adopts only the leftovers, instead of the whole ring
             # standing down until the claim TTL expires.
-            # dpowlint: disable=DPOW801 — the adoption setnx serializes passes for one death event; a duplicate add is idempotent
             self._adoption_incomplete.add(dead_id)
             await fence.release_adoption(self.store, dead_id, dead_epoch)
             logger.warning(
@@ -364,7 +396,6 @@ class ReplicaCoordinator:
                 self.replica_id, adopted, dead_id, len(leftovers),
             )
             return
-        # dpowlint: disable=DPOW801 — same serialization as the add above; discard of a drained id is idempotent
         self._adoption_incomplete.discard(dead_id)
         await fence.drop_member_record(self.store, dead_id, dead_epoch)
         logger.warning(

@@ -195,8 +195,13 @@ async def read_dispatches(store, replica_id: str) -> List[Tuple[str, Dict]]:
 async def claim_adoption(store, dead_id: str, dead_epoch: int, expire: float) -> bool:
     """Leaderless single-adopter election for one death event: the setnx
     winner adopts, everyone else stands down (the winner-lock idiom). The
-    TTL re-opens the claim if the adopter itself dies mid-takeover."""
-    return await store.setnx(adopt_key(dead_id, dead_epoch), "1", expire=expire)
+    TTL re-opens the claim if the adopter itself dies mid-takeover. The
+    winner's claim registers in the LeakLedger; release_adoption and
+    drop_member_record are its discharge points (obs/ledger.py)."""
+    won = await store.setnx(adopt_key(dead_id, dead_epoch), "1", expire=expire)
+    if won:
+        obs.LEDGER.acquire("claim", (dead_id, int(dead_epoch)))
+    return won
 
 
 async def release_adoption(store, dead_id: str, dead_epoch: int) -> None:
@@ -206,7 +211,12 @@ async def release_adoption(store, dead_id: str, dead_epoch: int) -> None:
     claimant — the same replica on its next poll, or any peer — re-adopts
     only what remains. Without this, a failed adoption pass in a
     two-replica ring stranded the leftovers until the TTL expired, and
-    the adopter itself never retried at all."""
+    the adopter itself never retried at all. Ledger discharge comes FIRST:
+    ownership ends the moment the adopter abandons the pass — if the
+    store delete itself fails (or a cancellation lands on it), the claim
+    key falls back to its TTL, which is the designed recovery, and the
+    ledger must not read that as a leak."""
+    obs.LEDGER.discharge("claim", (dead_id, int(dead_epoch)))
     await store.delete(adopt_key(dead_id, dead_epoch))
 
 
@@ -229,6 +239,12 @@ async def drop_member_record(store, dead_id: str, dead_epoch: int) -> None:
     to the dead incarnation: a zombie that rejoined at a fresh epoch
     during the adoption loop owns the key again, and deleting it would
     blip a LIVE member out of every peer's view."""
+    # The adoption pass that called us is COMPLETE: the claim key itself
+    # is left to its TTL on purpose (re-claiming a fully drained slice is
+    # harmless), but its ownership ends here — discharge the ledger with
+    # op="lapse" so a finished takeover reads as zero outstanding
+    # (count-neutral for callers that never held the claim).
+    obs.LEDGER.discharge("claim", (dead_id, int(dead_epoch)), op="lapse")
     record = await store.hgetall(member_key(dead_id))
     if not record:
         return

@@ -30,8 +30,19 @@ from __future__ import annotations
 import asyncio
 from typing import Callable, Dict, Optional
 
+from ..obs.ledger import LEDGER
 from ..resilience.clock import Clock, SystemClock
 from .queue import FairQueue, PRECACHE, Ticket
+
+
+def _ledger_kind(ticket: Ticket) -> str:
+    """LeakLedger kind for a window slot: a granted precache slot is a
+    LEASE (sweep-expirable), an on-demand slot is a TICKET (explicit
+    release only). One seam covers every grant/release/lapse path —
+    including acquire()'s cancellation handler — so the runtime ledger
+    (obs/ledger.py, dpowsan zero-outstanding invariant) cannot drift
+    from the admission bookkeeping."""
+    return "lease" if ticket.work_class == PRECACHE else "ticket"
 
 
 class Busy(Exception):
@@ -98,6 +109,7 @@ class DispatchWindow:
             else float("inf")
         )
         self._inflight[ticket] = expiry
+        LEDGER.acquire(_ledger_kind(ticket), ticket)
         self._inflight_by_service[ticket.service] = (
             self._inflight_by_service.get(ticket.service, 0) + 1
         )
@@ -173,6 +185,7 @@ class DispatchWindow:
 
     def release(self, ticket: Ticket) -> None:
         if self._inflight.pop(ticket, None) is not None:
+            LEDGER.discharge(_ledger_kind(ticket), ticket)
             self._drop_holding(ticket)
             self._grant_next()
 
@@ -191,6 +204,7 @@ class DispatchWindow:
         lapsed = [t for t, expiry in self._inflight.items() if expiry <= now]
         for ticket in lapsed:
             del self._inflight[ticket]
+            LEDGER.discharge(_ledger_kind(ticket), ticket, op="lapse")
             self._drop_holding(ticket)
         for ticket in self.queue.expired(now):
             self._fail(ticket, "shed", self.retry_after_hint)

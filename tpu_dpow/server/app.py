@@ -432,8 +432,18 @@ class DpowServer:
             return done
         task = asyncio.ensure_future(coro)
         self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
+        obs.LEDGER.acquire("bgtask", task)
+        task.add_done_callback(self._bg_task_done)
         return task
+
+    def _bg_task_done(self, task) -> None:
+        """Done-callback for every retained background write: the discard
+        keeps `_bg_tasks` from growing, the ledger discharge closes the
+        task's lifetime record. Runs for drained AND cancelled tasks —
+        close()/crash() detach the set but never the callbacks — so the
+        zero-outstanding teardown invariant holds on every exit path."""
+        self._bg_tasks.discard(task)
+        obs.LEDGER.discharge("bgtask", task)
 
     async def close(self) -> None:
         self._started = False
@@ -705,7 +715,7 @@ class DpowServer:
                 expire=self.config.block_expiry,
             )
             await self.store.delete(f"block-lock:{block_hash}")
-        self._forward_origins.setdefault(block_hash, set()).add(origin)
+        self._add_origin(block_hash, origin)
         if block_hash in self._journaled:
             # The dispatch is already journaled without this origin; an
             # adopter must know whom to relay to if we die now.
@@ -734,7 +744,7 @@ class DpowServer:
             # here or every shed forwarded hash leaks an entry (and a
             # later unrelated dispatch of the hash would relay to it).
             if block_hash not in self.work_futures:
-                self._forward_origins.pop(block_hash, None)
+                self._pop_origins(block_hash)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -746,7 +756,7 @@ class DpowServer:
             # any dispatch state existed (e.g. store error inside
             # admission) leaves no teardown to pop the origin set.
             if block_hash not in self.work_futures:
-                self._forward_origins.pop(block_hash, None)
+                self._pop_origins(block_hash)
 
     async def _relay_result_to(
         self, origin: str, block_hash: str, work: str, work_type: str
@@ -806,7 +816,7 @@ class DpowServer:
         Pops the origin set: at most one site relays per dispatch."""
         if self.replica is None:
             return
-        origins = self._forward_origins.pop(block_hash, None)
+        origins = self._pop_origins(block_hash)
         if not origins:
             return
         for origin in sorted(origins):
@@ -926,7 +936,8 @@ class DpowServer:
             difficulty, await self._recorded_difficulty(block_hash)
         )
         if origins:
-            self._forward_origins.setdefault(block_hash, set()).update(origins)
+            for origin in sorted(origins):
+                self._add_origin(block_hash, origin)
         existing = self.work_futures.get(block_hash)
         if existing is not None:
             # Already tracked here — typically OUR forward proxy to the
@@ -947,6 +958,7 @@ class DpowServer:
             return True
         fut = asyncio.get_running_loop().create_future()
         self.work_futures[block_hash] = fut
+        obs.LEDGER.acquire("future", block_hash)
         self._dispatched_difficulty[block_hash] = difficulty
         self._adopted_orphan.add(block_hash)
         self._m_dispatches.set(len(self.work_futures))
@@ -1321,12 +1333,35 @@ class DpowServer:
         if fut is not None and not fut.done():
             fut.cancel()
 
+    def _add_origin(self, block_hash: str, origin: str) -> None:
+        """Record one forwarder for a hash (ledger-tracked: every entry
+        added here must leave through _pop_origins, or the relay table
+        leaks — the PR-12 forward-origin leak class)."""
+        entries = self._forward_origins.setdefault(block_hash, set())
+        if origin not in entries:
+            entries.add(origin)
+            obs.LEDGER.acquire("origin", (block_hash, origin))
+
+    def _pop_origins(self, block_hash: str) -> Optional[Set[str]]:
+        """Drop (and return) a hash's whole origin set — the ONLY removal
+        path for origin entries, so the ledger discharge cannot be
+        forgotten at a new teardown site."""
+        origins = self._forward_origins.pop(block_hash, None)
+        if origins:
+            # Sorted: set iteration order varies with hash randomization,
+            # and the ledger trace must be identical across same-seed
+            # dpowsan runs.
+            for origin in sorted(origins):
+                obs.LEDGER.discharge("origin", (block_hash, origin))
+        return origins
+
     def _drop_dispatch_state(self, block_hash: str) -> None:
         """Remove ALL per-dispatch side tables for a hash. Single place on
         purpose: every dict that lives-and-dies with a work_futures entry
         must be dropped together, or a new table added later silently leaks
         at whichever teardown site forgot it."""
         del self.work_futures[block_hash]
+        obs.LEDGER.discharge("future", block_hash)
         self._dispatched_difficulty.pop(block_hash, None)
         self._difficulty_locks.pop(block_hash, None)
         self.supervisor.untrack(block_hash)
@@ -1335,7 +1370,7 @@ class DpowServer:
         if ticket is not None:
             self.admission.release(ticket)
         self._forwarded.discard(block_hash)
-        self._forward_origins.pop(block_hash, None)
+        self._pop_origins(block_hash)
         self._adopted_orphan.discard(block_hash)
         if block_hash in self._journaled:
             # Fire-and-forget, like the counter writes: teardown is sync
@@ -1535,6 +1570,7 @@ class DpowServer:
                 if owner != self.replica.replica_id:
                     proxy = asyncio.get_running_loop().create_future()
                     self.work_futures[block_hash] = proxy
+                    obs.LEDGER.acquire("future", block_hash)
                     self._forwarded.add(block_hash)
                     self._dispatched_difficulty[block_hash] = difficulty
                     self._m_dispatches.set(len(self.work_futures))
@@ -1626,6 +1662,7 @@ class DpowServer:
             gate = asyncio.get_running_loop().create_future()
             if self.config.coalesce:
                 self._dispatch_gates[block_hash] = gate
+                obs.LEDGER.acquire("gate", block_hash)
             try:
                 # Admission window (sched/window.py): a would-be dispatcher
                 # needs a slot before it may create the dispatch. This may
@@ -1686,8 +1723,14 @@ class DpowServer:
                 # final validation).
                 created = asyncio.get_running_loop().create_future()
                 self.work_futures[block_hash] = created
+                obs.LEDGER.acquire("future", block_hash)
                 # The window slot travels with the dispatch state from here
                 # on: _drop_dispatch_state releases it (every teardown path).
+                # Ownership-transfer discipline (DPOW1102): record the new
+                # owner FIRST, then neutralize the local handle — the
+                # prologue `finally` below must see None, or it and the
+                # teardown would both own the slot.
+                obs.LEDGER.transfer("ticket", ticket, note="dispatch-table")
                 self._dispatch_tickets[block_hash] = ticket
                 ticket = None
                 self._dispatched_difficulty[block_hash] = difficulty
@@ -1819,6 +1862,7 @@ class DpowServer:
                 # requests either find the installed dispatch or promote.
                 if self._dispatch_gates.get(block_hash) is gate:
                     del self._dispatch_gates[block_hash]
+                    obs.LEDGER.discharge("gate", block_hash)
                 if not gate.done():
                     gate.set_result(None)
             break
